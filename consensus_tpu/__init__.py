@@ -1,0 +1,48 @@
+"""consensus_tpu — a TPU-native Byzantine fault-tolerant SMR framework.
+
+A library-form PBFT-style consensus core (pre-prepare / prepare / commit with
+depth-1 pipelining, view changes with in-flight agreement, leader rotation and
+blacklisting, heartbeats, state transfer, CRC-chained WAL crash recovery, and
+dynamic reconfiguration), with the signature-heavy protocol paths drained into
+batched JAX/XLA verification kernels (ECDSA-P256 / Ed25519) that run on TPU.
+
+Capability parity target: hyperledger-labs/SmartBFT (see SURVEY.md).  The
+architecture is deliberately *not* a port:
+
+* The reference is goroutine-per-component with channel synchronization.  Here
+  each replica is a single-threaded, deterministic event-driven state machine
+  scheduled by ``consensus_tpu.runtime`` — which removes the reference's
+  deliver-vs-sync lock dance (reference: internal/bft/controller.go:928-965)
+  by construction, and makes every multi-replica test reproducible.
+* The reference verifies each commit signature on its own goroutine with
+  sequential CPU ECDSA (reference: internal/bft/view.go:537-541).  Here quorum
+  signature sets and request batches are *deferred and verified as one batch*
+  on the TPU (``consensus_tpu.models``), which is where the throughput
+  headroom of the MXU/VPU actually is.
+
+Layout:
+    api/       dependency-injection ports (the seam applications implement)
+    wire/      protobuf wire format + WAL record schema
+    wal/       segmented CRC-chained write-ahead log
+    runtime/   deterministic clock + event scheduler
+    core/      the consensus protocol state machines
+    ops/       TPU big-integer / modular-field kernels (jnp, vmap, pallas)
+    models/    batched signature-verification models built on ops/
+    parallel/  device-mesh sharding of the crypto batch path
+    utils/     quorum math, leader selection, blacklist, codecs
+    testing/   in-process simulated network + all-ports test application
+"""
+
+__version__ = "0.1.0"
+
+from consensus_tpu.types import (  # noqa: F401
+    Checkpoint,
+    Decision,
+    Proposal,
+    Reconfig,
+    RequestInfo,
+    Signature,
+    SyncResponse,
+    ViewSequence,
+)
+from consensus_tpu.config import Configuration, default_config  # noqa: F401

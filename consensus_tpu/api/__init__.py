@@ -1,0 +1,17 @@
+"""Dependency-injection ports: the interfaces an embedding application
+implements to wire the consensus core to its transport, storage, crypto, and
+ledger.
+"""
+
+from consensus_tpu.api.deps import (  # noqa: F401
+    Application,
+    Assembler,
+    BatchVerifier,
+    Comm,
+    MembershipNotifier,
+    RequestInspector,
+    Signer,
+    Synchronizer,
+    Verifier,
+    WriteAheadLog,
+)

@@ -1,0 +1,208 @@
+"""The ports (dependency interfaces) of the consensus core.
+
+Parity: reference pkg/api/dependencies.go:14-99 — Application, Comm,
+Assembler, WriteAheadLog, Signer, Verifier, MembershipNotifier,
+RequestInspector, Synchronizer (Logger is Python ``logging`` here).
+
+TPU-first deviation: ``Verifier`` exposes *batch* verification entry points
+(``verify_requests_batch``, ``verify_consenter_sigs_batch``) with looping
+defaults.  The protocol core always calls the batch forms — a TPU-backed
+verifier overrides them to drain whole quorums / request batches into one
+vmap'd kernel launch (the reference instead spawns one goroutine per commit
+signature, internal/bft/view.go:537-541).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+from consensus_tpu.types import (
+    Decision,
+    Proposal,
+    Reconfig,
+    RequestInfo,
+    Signature,
+    SyncResponse,
+)
+
+
+class Application(abc.ABC):
+    """The replicated state machine being driven by consensus.
+
+    Parity: reference pkg/api/dependencies.go:14-19.
+    """
+
+    @abc.abstractmethod
+    def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
+        """Commit a decided proposal; returns membership/config changes."""
+
+
+class Comm(abc.ABC):
+    """Unreliable, unordered, fire-and-forget message transport.
+
+    The protocol tolerates loss; delivery guarantees are *not* part of the
+    contract.  Parity: reference pkg/api/dependencies.go:22-30.
+    """
+
+    @abc.abstractmethod
+    def send_consensus(self, target_id: int, message) -> None: ...
+
+    @abc.abstractmethod
+    def send_transaction(self, target_id: int, request: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def nodes(self) -> Sequence[int]: ...
+
+
+class Assembler(abc.ABC):
+    """Builds application proposals out of request batches.
+
+    Parity: reference pkg/api/dependencies.go:33-37.
+    """
+
+    @abc.abstractmethod
+    def assemble_proposal(self, metadata: bytes, requests: Sequence[bytes]) -> Proposal: ...
+
+
+class WriteAheadLog(abc.ABC):
+    """Persistence for protocol step records (crash recovery).
+
+    Parity: reference pkg/api/dependencies.go:40-44.
+    """
+
+    @abc.abstractmethod
+    def append(self, entry: bytes, truncate_to: bool = False) -> None: ...
+
+
+class Signer(abc.ABC):
+    """This replica's signing identity.
+
+    Parity: reference pkg/api/dependencies.go:47-52.
+    """
+
+    @abc.abstractmethod
+    def sign(self, data: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature: ...
+
+
+class Verifier(abc.ABC):
+    """Validation of requests, proposals, and signatures.
+
+    Parity: reference pkg/api/dependencies.go:55-71 (7 methods), plus the
+    batch entry points the TPU engine accelerates.
+    """
+
+    @abc.abstractmethod
+    def verify_proposal(self, proposal: Proposal) -> Sequence[RequestInfo]:
+        """Fully verify a proposal (including its requests); returns their
+        infos, or raises on failure."""
+
+    @abc.abstractmethod
+    def verify_request(self, raw_request: bytes) -> RequestInfo:
+        """Verify a single client request; returns its info or raises."""
+
+    @abc.abstractmethod
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        """Verify a consenter's signature over a proposal; returns the
+        auxiliary payload it vouches for (see blacklist redemption), or
+        raises."""
+
+    @abc.abstractmethod
+    def verify_signature(self, signature: Signature) -> None:
+        """Verify a raw signature (view-change data); raises on failure."""
+
+    @abc.abstractmethod
+    def verification_sequence(self) -> int:
+        """The current membership/config epoch requests are verified under."""
+
+    @abc.abstractmethod
+    def requests_from_proposal(self, proposal: Proposal) -> Sequence[RequestInfo]:
+        """Cheaply list the request infos inside a proposal (no verification)."""
+
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        """Extract auxiliary data out of a signed message payload."""
+        return b""
+
+    # --- batch entry points (TPU acceleration seam) ---------------------
+
+    def verify_requests_batch(self, raw_requests: Sequence[bytes]) -> list[Optional[RequestInfo]]:
+        """Verify many requests; element is None where verification failed.
+
+        Default loops over ``verify_request``; TPU verifiers override.
+        """
+        out: list[Optional[RequestInfo]] = []
+        for raw in raw_requests:
+            try:
+                out.append(self.verify_request(raw))
+            except Exception:
+                out.append(None)
+        return out
+
+    def verify_consenter_sigs_batch(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list[Optional[bytes]]:
+        """Verify many consenter signatures over one proposal; element is the
+        auxiliary payload, or None where verification failed.
+
+        Default loops over ``verify_consenter_sig``; TPU verifiers override.
+        """
+        out: list[Optional[bytes]] = []
+        for sig in signatures:
+            try:
+                out.append(self.verify_consenter_sig(sig, proposal))
+            except Exception:
+                out.append(None)
+        return out
+
+
+# Convenience alias for implementations that only provide the batch forms.
+BatchVerifier = Verifier
+
+
+class MembershipNotifier(abc.ABC):
+    """Notified when a decision changed cluster membership.
+
+    Parity: reference pkg/api/dependencies.go:74-77.
+    """
+
+    @abc.abstractmethod
+    def membership_change(self) -> None: ...
+
+
+class RequestInspector(abc.ABC):
+    """Extracts (client, request) identity from raw request bytes.
+
+    Parity: reference pkg/api/dependencies.go:80-83.
+    """
+
+    @abc.abstractmethod
+    def request_id(self, raw_request: bytes) -> RequestInfo: ...
+
+
+class Synchronizer(abc.ABC):
+    """Application-level catch-up: fetch and deliver decided proposals from
+    peers, returning the latest decision reached.
+
+    Parity: reference pkg/api/dependencies.go:86-90.
+    """
+
+    @abc.abstractmethod
+    def sync(self) -> SyncResponse: ...
+
+
+__all__ = [
+    "Application",
+    "Comm",
+    "Assembler",
+    "WriteAheadLog",
+    "Signer",
+    "Verifier",
+    "BatchVerifier",
+    "MembershipNotifier",
+    "RequestInspector",
+    "Synchronizer",
+    "Decision",
+]

@@ -1,0 +1,139 @@
+"""Replica configuration: batching, pool, timeout cascade, view-change and
+heartbeat tuning, rotation, and the TPU crypto-batching knobs.
+
+Parity: reference pkg/types/config.go:15-188 (Configuration, DefaultConfig,
+Validate).  Times are float seconds (the runtime clock is injectable, so tests
+use a simulated clock rather than shrinking these).  TPU-specific additions
+(`crypto_*`) are new — they tune the batch signature-verification engine and
+have no reference counterpart.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class Configuration:
+    # --- identity -------------------------------------------------------
+    self_id: int = 0
+
+    # --- batching (leader) ---------------------------------------------
+    # Parity: reference pkg/types/config.go:21-29,94-96 (defaults 100 / 10MB / 50ms).
+    request_batch_max_count: int = 100
+    request_batch_max_bytes: int = 10 * 1024 * 1024
+    request_batch_max_interval: float = 0.050
+
+    # --- message ingress ------------------------------------------------
+    incoming_message_buffer_size: int = 200
+
+    # --- request pool + timeout cascade ---------------------------------
+    # Parity: reference pkg/types/config.go:37-55.
+    request_pool_size: int = 400
+    request_max_bytes: int = 10 * 1024
+    request_forward_timeout: float = 2.0
+    request_complain_timeout: float = 20.0
+    request_auto_remove_timeout: float = 180.0
+    submit_timeout: float = 10.0
+
+    # --- view change ----------------------------------------------------
+    # Parity: reference pkg/types/config.go:57-66.
+    view_change_resend_interval: float = 5.0
+    view_change_timeout: float = 20.0
+    speed_up_view_change: bool = False
+
+    # --- heartbeats / failure detection ---------------------------------
+    # Parity: reference pkg/types/config.go:68-75.
+    leader_heartbeat_timeout: float = 60.0
+    leader_heartbeat_count: int = 10
+    num_of_ticks_behind_before_syncing: int = 10
+
+    # --- state transfer -------------------------------------------------
+    collect_timeout: float = 1.0
+
+    # --- leader rotation ------------------------------------------------
+    # Parity: reference pkg/types/config.go:77-84.
+    leader_rotation: bool = False
+    decisions_per_leader: int = 3
+
+    # --- lifecycle ------------------------------------------------------
+    sync_on_start: bool = False
+
+    # --- TPU crypto engine (no reference counterpart) -------------------
+    # Minimum number of pending verifications before the engine prefers the
+    # TPU path over the CPU fallback, and the micro-batch coalescing window.
+    crypto_tpu_min_batch: int = 16
+    crypto_batch_window: float = 0.002
+    # Pad verification batches up to the next power of two (stable XLA shapes,
+    # avoids recompilation across batch sizes).
+    crypto_pad_pow2: bool = True
+
+    def validate(self) -> None:
+        """Cross-field validation. Parity: reference pkg/types/config.go:116-188."""
+        errs = []
+        if self.self_id == 0:
+            errs.append("self_id must be set (nonzero)")
+        if self.request_batch_max_count <= 0:
+            errs.append("request_batch_max_count must be positive")
+        if self.request_batch_max_bytes <= 0:
+            errs.append("request_batch_max_bytes must be positive")
+        if self.request_batch_max_interval <= 0:
+            errs.append("request_batch_max_interval must be positive")
+        if self.request_max_bytes <= 0:
+            errs.append("request_max_bytes must be positive")
+        if self.request_batch_max_bytes < self.request_max_bytes:
+            errs.append("request_batch_max_bytes must be >= request_max_bytes")
+        if self.incoming_message_buffer_size <= 0:
+            errs.append("incoming_message_buffer_size must be positive")
+        if self.request_pool_size <= 0:
+            errs.append("request_pool_size must be positive")
+        if self.submit_timeout <= 0:
+            errs.append("submit_timeout must be positive")
+        if self.request_forward_timeout <= 0:
+            errs.append("request_forward_timeout must be positive")
+        if self.request_complain_timeout <= 0:
+            errs.append("request_complain_timeout must be positive")
+        if self.request_auto_remove_timeout <= 0:
+            errs.append("request_auto_remove_timeout must be positive")
+        if not (
+            self.request_forward_timeout
+            <= self.request_complain_timeout
+            <= self.request_auto_remove_timeout
+        ):
+            errs.append(
+                "timeout cascade must satisfy forward <= complain <= auto_remove"
+            )
+        if self.view_change_resend_interval <= 0:
+            errs.append("view_change_resend_interval must be positive")
+        if self.view_change_timeout <= 0:
+            errs.append("view_change_timeout must be positive")
+        if self.view_change_resend_interval > self.view_change_timeout:
+            errs.append("view_change_resend_interval must be <= view_change_timeout")
+        if self.leader_heartbeat_timeout <= 0:
+            errs.append("leader_heartbeat_timeout must be positive")
+        if self.leader_heartbeat_count <= 0:
+            errs.append("leader_heartbeat_count must be positive")
+        if self.num_of_ticks_behind_before_syncing <= 0:
+            errs.append("num_of_ticks_behind_before_syncing must be positive")
+        if self.collect_timeout <= 0:
+            errs.append("collect_timeout must be positive")
+        if self.leader_rotation and self.decisions_per_leader <= 0:
+            errs.append("decisions_per_leader must be positive when rotating")
+        if errs:
+            raise ValueError("invalid configuration: " + "; ".join(errs))
+
+    def with_(self, **kw) -> "Configuration":
+        return replace(self, **kw)
+
+
+def default_config(self_id: int) -> Configuration:
+    """A validated default configuration for ``self_id``.
+
+    Parity: reference pkg/types/config.go:93-114.
+    """
+    cfg = Configuration(self_id=self_id)
+    cfg.validate()
+    return cfg
+
+
+__all__ = ["Configuration", "default_config"]

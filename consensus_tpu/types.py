@@ -1,0 +1,163 @@
+"""Core value types shared by the protocol, the ports, and applications.
+
+Parity: reference pkg/types/types.go:18-123 (Proposal, Signature, Decision,
+RequestInfo, Checkpoint, Reconfig, SyncResponse).  The digest construction is
+deterministic SHA-256 over a length-prefixed field encoding (the reference
+uses ASN.1 + SHA-256, pkg/types/types.go:50-62; byte-compatibility with the Go
+wire is a non-goal — shape compatibility is).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Optional, Sequence
+
+
+def _lp(buf: bytes) -> bytes:
+    """Length-prefix a byte string (u64 big-endian) for deterministic hashing."""
+    return struct.pack(">Q", len(buf)) + buf
+
+
+@dataclass(frozen=True)
+class RequestInfo:
+    """Identity of a client request: (client id, request id).
+
+    Parity: reference pkg/types/types.go:44-48.
+    """
+
+    client_id: str
+    request_id: str
+
+    def key(self) -> str:
+        return self.client_id + "\x00" + self.request_id
+
+    def __str__(self) -> str:  # used in logs
+        return f"{self.client_id}/{self.request_id}"
+
+
+@dataclass(frozen=True)
+class Proposal:
+    """A batch of requests assembled by the leader, plus consensus metadata.
+
+    ``payload`` carries the application batch, ``header`` application framing,
+    ``metadata`` the serialized ViewMetadata stamped by the leader, and
+    ``verification_sequence`` the membership/config epoch under which the
+    proposal must be verified.  Parity: reference pkg/types/types.go:18-30.
+    """
+
+    payload: bytes = b""
+    header: bytes = b""
+    metadata: bytes = b""
+    verification_sequence: int = 0
+
+    def digest(self) -> str:
+        """Deterministic content digest (hex).
+
+        Parity: reference pkg/types/types.go:50-62 (ASN.1+SHA-256 there).
+        """
+        h = hashlib.sha256()
+        h.update(struct.pack(">q", self.verification_sequence))
+        h.update(_lp(self.header))
+        h.update(_lp(self.payload))
+        h.update(_lp(self.metadata))
+        return h.hexdigest()
+
+
+@dataclass(frozen=True)
+class Signature:
+    """A consenter's signature over a proposal.
+
+    ``msg`` is auxiliary signed payload (the reference threads the
+    prepare-sender id list through it for blacklist redemption voting —
+    internal/bft/view.go:472-481).  Parity: reference pkg/types/types.go:32-37.
+    """
+
+    id: int
+    value: bytes = b""
+    msg: bytes = b""
+
+
+@dataclass(frozen=True)
+class Decision:
+    """A committed proposal together with its quorum of signatures.
+
+    Parity: reference pkg/types/types.go:39-42.
+    """
+
+    proposal: Proposal
+    signatures: tuple[Signature, ...] = ()
+
+
+@dataclass(frozen=True)
+class Reconfig:
+    """Signals that the latest decision changed membership or configuration.
+
+    Parity: reference pkg/types/types.go:107-111.
+    """
+
+    in_latest_decision: bool = False
+    current_nodes: tuple[int, ...] = ()
+    current_config: Optional["object"] = None  # Configuration; avoid cycle
+
+
+@dataclass(frozen=True)
+class SyncResponse:
+    """Result of Synchronizer.sync(): the latest decision plus any reconfig.
+
+    Parity: reference pkg/types/types.go:113-116.
+    """
+
+    latest: Optional[Decision] = None
+    reconfig: Reconfig = field(default_factory=Reconfig)
+
+
+@dataclass(frozen=True)
+class ViewSequence:
+    """A replica's current (view, proposal sequence) and whether the view is
+    active.  Exchanged in state-transfer responses.
+
+    Parity: reference internal/bft types (ViewSequence in controller.go).
+    """
+
+    view_active: bool = False
+    view: int = 0
+    seq: int = 0
+
+
+class Checkpoint:
+    """Thread-safe holder of the last decided proposal + its signature quorum.
+
+    Fed on every decision and by sync; anchors view changes (the last-decision
+    proof inside ViewData) and the leader's proposal metadata.
+    Parity: reference pkg/types/types.go:71-105.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._proposal: Proposal = Proposal()
+        self._signatures: tuple[Signature, ...] = ()
+
+    def get(self) -> tuple[Proposal, tuple[Signature, ...]]:
+        with self._lock:
+            return self._proposal, self._signatures
+
+    def set(self, proposal: Proposal, signatures: Sequence[Signature]) -> None:
+        with self._lock:
+            self._proposal = proposal
+            self._signatures = tuple(signatures)
+
+
+__all__ = [
+    "RequestInfo",
+    "Proposal",
+    "Signature",
+    "Decision",
+    "Reconfig",
+    "SyncResponse",
+    "ViewSequence",
+    "Checkpoint",
+    "replace",
+]

@@ -1,0 +1,13 @@
+"""Pure protocol math: quorum sizes, leader selection, blacklist maintenance,
+vote bookkeeping, and deterministic digests.  No I/O, no clocks — everything
+here is table-testable.
+"""
+
+from consensus_tpu.utils.quorum import compute_quorum  # noqa: F401
+from consensus_tpu.utils.leader import get_leader_id  # noqa: F401
+from consensus_tpu.utils.blacklist import (  # noqa: F401
+    compute_blacklist_update,
+    prune_blacklist,
+)
+from consensus_tpu.utils.votes import VoteSet, NextViews  # noqa: F401
+from consensus_tpu.utils.digests import commit_signatures_digest  # noqa: F401

@@ -1,0 +1,32 @@
+"""Deterministic digests binding signature sets into proposal metadata.
+
+Parity: reference internal/bft/util.go:564-586 (CommitSignaturesDigest,
+ASN.1 + SHA-256 there; here a length-prefixed encoding + SHA-256 — the wire
+is ours, only the binding property matters: the digest commits to the exact
+ordered (signer, value, msg) triples).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Sequence
+
+from consensus_tpu.types import Signature
+
+
+def commit_signatures_digest(sigs: Sequence[Signature]) -> bytes:
+    """Digest of an ordered list of commit signatures; empty input -> b''."""
+    if not sigs:
+        return b""
+    h = hashlib.sha256()
+    for sig in sigs:
+        h.update(struct.pack(">q", sig.id))
+        h.update(struct.pack(">Q", len(sig.value)))
+        h.update(sig.value)
+        h.update(struct.pack(">Q", len(sig.msg)))
+        h.update(sig.msg)
+    return h.digest()
+
+
+__all__ = ["commit_signatures_digest"]

@@ -1,0 +1,62 @@
+"""Vote bookkeeping: dedup-by-sender vote sets and next-view tracking.
+
+Parity: reference internal/bft/util.go:109-163 (voteSet, nextViews).  Unlike
+the reference (which buffers votes on a channel consumed by a goroutine),
+votes here are plain lists inspected synchronously by the owning state
+machine — the runtime is single-threaded per replica.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class Vote:
+    sender: int
+    msg: Any
+
+
+class VoteSet:
+    """Collects at most one vote per sender, subject to a validity predicate."""
+
+    def __init__(self, valid_vote: Optional[Callable[[int, Any], bool]] = None):
+        self._valid = valid_vote or (lambda sender, msg: True)
+        self.voted: set[int] = set()
+        self.votes: list[Vote] = []
+
+    def clear(self) -> None:
+        self.voted.clear()
+        self.votes.clear()
+
+    def register(self, sender: int, msg: Any) -> bool:
+        """Record the vote; returns True if it was fresh and valid."""
+        if sender in self.voted or not self._valid(sender, msg):
+            return False
+        self.voted.add(sender)
+        self.votes.append(Vote(sender, msg))
+        return True
+
+    def __len__(self) -> int:
+        return len(self.votes)
+
+
+class NextViews:
+    """Tracks the highest next-view each sender announced (view-change help)."""
+
+    def __init__(self) -> None:
+        self._next: dict[int, int] = {}
+
+    def clear(self) -> None:
+        self._next.clear()
+
+    def register(self, next_view: int, sender: int) -> None:
+        if next_view > self._next.get(sender, 0):
+            self._next[sender] = next_view
+
+    def matches(self, next_view: int, sender: int) -> bool:
+        return self._next.get(sender, 0) == next_view
+
+
+__all__ = ["Vote", "VoteSet", "NextViews"]

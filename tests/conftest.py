@@ -1,0 +1,13 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any ``import jax`` anywhere in the test session, hence the
+env mutation at conftest import time.  Bench runs (bench.py) use the real TPU
+instead; tests are CPU-deterministic.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

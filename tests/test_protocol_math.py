@@ -1,0 +1,168 @@
+"""Quorum, leader selection, blacklist — table-driven.
+
+Coverage model: reference internal/bft/util_test.go (TestQuorum:135,
+TestGetLeaderId:165, TestBlacklist:20).
+"""
+
+import pytest
+
+from consensus_tpu.utils import (
+    NextViews,
+    VoteSet,
+    compute_blacklist_update,
+    compute_quorum,
+    get_leader_id,
+    prune_blacklist,
+)
+
+
+class TestQuorum:
+    # (n, expected_q, expected_f)
+    TABLE = [
+        (1, 1, 0),
+        (2, 2, 0),
+        (3, 2, 0),
+        (4, 3, 1),
+        (5, 4, 1),
+        (6, 4, 1),
+        (7, 5, 2),
+        (8, 6, 2),
+        (9, 6, 2),
+        (10, 7, 3),
+        (11, 8, 3),
+        (12, 8, 3),
+        (13, 9, 4),
+        (22, 15, 7),
+        (100, 67, 33),
+    ]
+
+    @pytest.mark.parametrize("n,q,f", TABLE)
+    def test_table(self, n, q, f):
+        assert compute_quorum(n) == (q, f)
+
+    def test_intersection_property(self):
+        # Any two quorums of size q among n nodes intersect in >= f+1 nodes.
+        for n in range(1, 50):
+            q, f = compute_quorum(n)
+            assert 2 * q - n >= f + 1
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            compute_quorum(0)
+
+
+class TestLeaderSelection:
+    NODES = [11, 22, 33, 44]
+
+    def test_static_per_view(self):
+        for view in range(10):
+            assert (
+                get_leader_id(view, 4, self.NODES, leader_rotation=False)
+                == self.NODES[view % 4]
+            )
+
+    def test_rotation_advances_with_decisions(self):
+        # decisions_per_leader=2: leadership hops every 2 decisions.
+        got = [
+            get_leader_id(
+                0, 4, self.NODES,
+                leader_rotation=True, decisions_in_view=d, decisions_per_leader=2,
+            )
+            for d in range(8)
+        ]
+        assert got == [11, 11, 22, 22, 33, 33, 44, 44]
+
+    def test_rotation_skips_blacklisted(self):
+        leader = get_leader_id(
+            1, 4, self.NODES,
+            leader_rotation=True, decisions_in_view=0, decisions_per_leader=1,
+            blacklist=[22, 33],
+        )
+        assert leader == 44
+
+    def test_all_blacklisted_raises(self):
+        with pytest.raises(RuntimeError):
+            get_leader_id(
+                0, 4, self.NODES,
+                leader_rotation=True, decisions_per_leader=1, blacklist=self.NODES,
+            )
+
+
+class TestBlacklist:
+    NODES = [1, 2, 3, 4, 5, 6, 7]  # n=7 -> f=2
+
+    def test_view_change_blacklists_skipped_leaders(self):
+        # View moved 1 -> 3: leaders of views 1 and 2 get blacklisted
+        # (unless one of them is the current leader).
+        bl = compute_blacklist_update(
+            prev_view=1, prev_seq=5, prev_decisions_in_view=0, prev_blacklist=[],
+            current_view=3, current_leader=4,
+            n=7, f=2, nodes=self.NODES,
+            leader_rotation=True, decisions_per_leader=1000, prepares_from={},
+        )
+        # leaders of views 1 and 2 (decisions offset 1, dpl=1000): nodes[1]=2, nodes[2]=3.
+        assert bl == [2, 3]
+
+    def test_same_view_redemption(self):
+        # 3 distinct signers (> f=2) vouch for node 2 -> redeemed.
+        bl = compute_blacklist_update(
+            prev_view=0, prev_seq=9, prev_decisions_in_view=3, prev_blacklist=[2, 5],
+            current_view=0, current_leader=1,
+            n=7, f=2, nodes=self.NODES,
+            leader_rotation=True, decisions_per_leader=1,
+            prepares_from={3: [2], 4: [2], 6: [2, 5], 7: []},
+        )
+        assert bl == [5]
+
+    def test_capped_at_f(self):
+        bl = compute_blacklist_update(
+            prev_view=0, prev_seq=3, prev_decisions_in_view=0, prev_blacklist=[1, 2],
+            current_view=2, current_leader=5,
+            n=7, f=2, nodes=self.NODES,
+            leader_rotation=True, decisions_per_leader=1000, prepares_from={},
+        )
+        assert len(bl) <= 2
+        # oldest entries evicted first
+        assert 1 not in bl
+
+    def test_prune_removes_departed_nodes(self):
+        assert prune_blacklist([9, 2], {}, 2, self.NODES) == [2]
+
+    def test_prune_empty(self):
+        assert prune_blacklist([], {1: [2]}, 2, self.NODES) == []
+
+
+class TestVoteSet:
+    def test_dedup_by_sender(self):
+        vs = VoteSet()
+        assert vs.register(1, "a")
+        assert not vs.register(1, "b")
+        assert vs.register(2, "c")
+        assert len(vs) == 2
+
+    def test_validity_predicate(self):
+        vs = VoteSet(valid_vote=lambda s, m: m == "ok")
+        assert not vs.register(1, "bad")
+        assert vs.register(1, "ok")
+
+    def test_clear(self):
+        vs = VoteSet()
+        vs.register(1, "a")
+        vs.clear()
+        assert len(vs) == 0
+        assert vs.register(1, "a")
+
+
+class TestNextViews:
+    def test_register_keeps_max(self):
+        nv = NextViews()
+        nv.register(3, sender=1)
+        nv.register(2, sender=1)
+        assert nv.matches(3, sender=1)
+        assert not nv.matches(2, sender=1)
+
+    def test_clear(self):
+        nv = NextViews()
+        nv.register(3, sender=1)
+        nv.clear()
+        assert not nv.matches(3, sender=1)

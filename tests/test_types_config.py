@@ -1,0 +1,104 @@
+"""Value types + configuration validation.
+
+Coverage model: reference pkg/types (types.go digest semantics, config.go
+Validate cross-field rules).
+"""
+
+import pytest
+
+from consensus_tpu import Configuration, Proposal, Signature, Checkpoint, default_config
+from consensus_tpu.utils import commit_signatures_digest
+
+
+class TestProposalDigest:
+    def test_digest_deterministic(self):
+        p = Proposal(payload=b"abc", header=b"h", metadata=b"m", verification_sequence=3)
+        assert p.digest() == p.digest()
+
+    def test_digest_sensitive_to_every_field(self):
+        base = Proposal(payload=b"abc", header=b"h", metadata=b"m", verification_sequence=3)
+        variants = [
+            Proposal(payload=b"abd", header=b"h", metadata=b"m", verification_sequence=3),
+            Proposal(payload=b"abc", header=b"H", metadata=b"m", verification_sequence=3),
+            Proposal(payload=b"abc", header=b"h", metadata=b"M", verification_sequence=3),
+            Proposal(payload=b"abc", header=b"h", metadata=b"m", verification_sequence=4),
+        ]
+        digests = {base.digest()} | {v.digest() for v in variants}
+        assert len(digests) == 5
+
+    def test_digest_no_field_bleed(self):
+        # Moving a byte across the field boundary must change the digest.
+        a = Proposal(payload=b"ab", header=b"c")
+        b = Proposal(payload=b"a", header=b"bc")
+        assert a.digest() != b.digest()
+
+
+class TestCheckpoint:
+    def test_set_get_roundtrip(self):
+        cp = Checkpoint()
+        p = Proposal(payload=b"x")
+        sigs = [Signature(id=1, value=b"v")]
+        cp.set(p, sigs)
+        got_p, got_sigs = cp.get()
+        assert got_p == p
+        assert got_sigs == (sigs[0],)
+
+
+class TestCommitSignaturesDigest:
+    def test_empty(self):
+        assert commit_signatures_digest([]) == b""
+
+    def test_order_sensitive(self):
+        a = Signature(id=1, value=b"v1", msg=b"m1")
+        b = Signature(id=2, value=b"v2", msg=b"m2")
+        assert commit_signatures_digest([a, b]) != commit_signatures_digest([b, a])
+
+    def test_field_sensitive(self):
+        a = Signature(id=1, value=b"v1", msg=b"m1")
+        a2 = Signature(id=1, value=b"v1", msg=b"m2")
+        assert commit_signatures_digest([a]) != commit_signatures_digest([a2])
+
+
+class TestConfiguration:
+    def test_default_is_valid(self):
+        cfg = default_config(self_id=1)
+        assert cfg.self_id == 1
+
+    def test_zero_id_rejected(self):
+        with pytest.raises(ValueError, match="self_id"):
+            Configuration(self_id=0).validate()
+
+    @pytest.mark.parametrize(
+        "field,value",
+        [
+            ("request_batch_max_count", 0),
+            ("request_batch_max_bytes", 0),
+            ("request_batch_max_interval", 0.0),
+            ("request_pool_size", -1),
+            ("submit_timeout", 0.0),
+            ("view_change_timeout", 0.0),
+            ("leader_heartbeat_count", 0),
+            ("collect_timeout", 0.0),
+        ],
+    )
+    def test_nonpositive_rejected(self, field, value):
+        with pytest.raises(ValueError):
+            Configuration(self_id=1, **{field: value}).validate()
+
+    def test_timeout_cascade_order_enforced(self):
+        with pytest.raises(ValueError, match="cascade"):
+            Configuration(
+                self_id=1,
+                request_forward_timeout=10.0,
+                request_complain_timeout=5.0,
+            ).validate()
+
+    def test_batch_bytes_vs_request_bytes(self):
+        with pytest.raises(ValueError, match="request_max_bytes"):
+            Configuration(
+                self_id=1, request_batch_max_bytes=100, request_max_bytes=200
+            ).validate()
+
+    def test_rotation_requires_decisions_per_leader(self):
+        with pytest.raises(ValueError, match="decisions_per_leader"):
+            Configuration(self_id=1, leader_rotation=True, decisions_per_leader=0).validate()
