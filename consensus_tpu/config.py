@@ -34,7 +34,7 @@ class Configuration:
     request_forward_timeout: float = 2.0
     request_complain_timeout: float = 20.0
     request_auto_remove_timeout: float = 180.0
-    submit_timeout: float = 10.0
+    submit_timeout: float = 5.0
 
     # --- view change ----------------------------------------------------
     # Parity: reference pkg/types/config.go:57-66.
@@ -52,8 +52,9 @@ class Configuration:
     collect_timeout: float = 1.0
 
     # --- leader rotation ------------------------------------------------
-    # Parity: reference pkg/types/config.go:77-84.
-    leader_rotation: bool = False
+    # Parity: reference pkg/types/config.go:77-84,109-111 (defaults: rotation
+    # on, 3 decisions per leader).
+    leader_rotation: bool = True
     decisions_per_leader: int = 3
 
     # --- lifecycle ------------------------------------------------------
@@ -119,6 +120,8 @@ class Configuration:
             errs.append("collect_timeout must be positive")
         if self.leader_rotation and self.decisions_per_leader <= 0:
             errs.append("decisions_per_leader must be positive when rotating")
+        if not self.leader_rotation and self.decisions_per_leader != 0:
+            errs.append("decisions_per_leader must be zero when rotation is off")
         if errs:
             raise ValueError("invalid configuration: " + "; ".join(errs))
 

@@ -59,7 +59,7 @@ class Proposal:
         Parity: reference pkg/types/types.go:50-62 (ASN.1+SHA-256 there).
         """
         h = hashlib.sha256()
-        h.update(struct.pack(">q", self.verification_sequence))
+        h.update(struct.pack(">Q", self.verification_sequence))
         h.update(_lp(self.header))
         h.update(_lp(self.payload))
         h.update(_lp(self.metadata))

@@ -28,8 +28,9 @@ def prune_blacklist(
     ``prepares_from`` maps a commit-signer id to the list of node ids it
     attested to have received prepares from (carried in the auxiliary signed
     payload of commit signatures).  A blacklisted node vouched for by more
-    than ``f`` distinct signers is redeemed; nodes removed from membership
-    are purged unconditionally.
+    than ``f`` distinct signers is redeemed (each signer counts once, however
+    many times it repeats a node in its vouch list); nodes removed from
+    membership are purged unconditionally.
     """
     if not prev_blacklist:
         return []
@@ -37,7 +38,7 @@ def prune_blacklist(
     member = frozenset(nodes)
     ack_count: dict[int, int] = {}
     for _, vouched in prepares_from.items():
-        for prepare_sender in vouched:
+        for prepare_sender in set(vouched):
             ack_count[prepare_sender] = ack_count.get(prepare_sender, 0) + 1
 
     kept: list[int] = []

@@ -21,7 +21,7 @@ def commit_signatures_digest(sigs: Sequence[Signature]) -> bytes:
         return b""
     h = hashlib.sha256()
     for sig in sigs:
-        h.update(struct.pack(">q", sig.id))
+        h.update(struct.pack(">Q", sig.id))
         h.update(struct.pack(">Q", len(sig.value)))
         h.update(sig.value)
         h.update(struct.pack(">Q", len(sig.msg)))

@@ -1,0 +1,540 @@
+"""Deterministic binary codec for wire messages and WAL records.
+
+Replaces the reference's protobuf layer (smartbftprotos/messages.pb.go) with a
+compact hand-rolled tag + length-prefixed encoding.  Properties the protocol
+relies on:
+
+* **Deterministic** — the same message always encodes to the same bytes
+  (protobuf does not guarantee this across implementations).  ViewData
+  signatures and WAL CRC chains are computed over these bytes.
+* **Self-delimiting** — every value knows its own length, so records can be
+  concatenated (WAL) or nested (SignedViewData.raw_view_data).
+* **Versioned** — one format-version byte leads every envelope so the codec
+  can evolve.
+
+Primitive layer: u8, u64 (big-endian), bool, bytes (u32 length prefix),
+str (utf-8 bytes), and homogeneous sequences (u32 count prefix).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Optional, Sequence
+
+from consensus_tpu.types import Proposal, Signature
+from consensus_tpu.wire.messages import (
+    Commit,
+    ConsensusMessage,
+    HeartBeat,
+    HeartBeatResponse,
+    NewView,
+    PrePrepare,
+    Prepare,
+    PreparesFrom,
+    ProposedRecord,
+    SavedCommit,
+    SavedMessage,
+    SavedNewView,
+    SavedViewChange,
+    SignedViewData,
+    StateTransferRequest,
+    StateTransferResponse,
+    ViewChange,
+    ViewData,
+    ViewMetadata,
+)
+
+_VERSION = 1
+
+# Domain discriminators: the second envelope byte separates the wire-message
+# and WAL-record encodings so bytes from one domain can never silently decode
+# in the other (e.g. a misrouted buffer during crash recovery).
+_DOMAIN_WIRE = 0x57  # 'W'
+_DOMAIN_SAVED = 0x4C  # 'L'
+
+
+class CodecError(ValueError):
+    """Raised on malformed input bytes."""
+
+
+class _Writer:
+    __slots__ = ("_parts",)
+
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    def u8(self, v: int) -> None:
+        self._parts.append(struct.pack(">B", v))
+
+    def u64(self, v: int) -> None:
+        self._parts.append(struct.pack(">Q", v))
+
+    def boolean(self, v: bool) -> None:
+        self._parts.append(b"\x01" if v else b"\x00")
+
+    def blob(self, v: bytes) -> None:
+        self._parts.append(struct.pack(">I", len(v)))
+        self._parts.append(v)
+
+    def text(self, v: str) -> None:
+        self.blob(v.encode("utf-8"))
+
+    def seq(self, items: Sequence, write_item: Callable) -> None:
+        self._parts.append(struct.pack(">I", len(items)))
+        for item in items:
+            write_item(item)
+
+    def getvalue(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class _Reader:
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, buf: bytes) -> None:
+        self._buf = buf
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._buf):
+            raise CodecError(
+                f"truncated input: need {n} bytes at offset {self._pos}, "
+                f"have {len(self._buf) - self._pos}"
+            )
+        out = self._buf[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u64(self) -> int:
+        return struct.unpack(">Q", self._take(8))[0]
+
+    def boolean(self) -> bool:
+        b = self._take(1)[0]
+        if b not in (0, 1):
+            raise CodecError(f"invalid bool byte {b!r}")
+        return b == 1
+
+    def blob(self) -> bytes:
+        n = struct.unpack(">I", self._take(4))[0]
+        return self._take(n)
+
+    def text(self) -> str:
+        try:
+            return self.blob().decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise CodecError(f"invalid utf-8: {e}") from e
+
+    def seq(self, read_item: Callable) -> tuple:
+        n = struct.unpack(">I", self._take(4))[0]
+        if n > len(self._buf):  # cheap sanity bound: each item is >= 1 byte
+            raise CodecError(f"implausible sequence count {n}")
+        return tuple(read_item() for _ in range(n))
+
+    def expect_end(self) -> None:
+        if self._pos != len(self._buf):
+            raise CodecError(f"{len(self._buf) - self._pos} trailing bytes")
+
+
+# --- shared value encoders -----------------------------------------------
+
+
+def _w_proposal(w: _Writer, p: Proposal) -> None:
+    w.blob(p.header)
+    w.blob(p.payload)
+    w.blob(p.metadata)
+    w.u64(p.verification_sequence)
+
+
+def _r_proposal(r: _Reader) -> Proposal:
+    header = r.blob()
+    payload = r.blob()
+    metadata = r.blob()
+    vseq = r.u64()
+    return Proposal(
+        header=header, payload=payload, metadata=metadata, verification_sequence=vseq
+    )
+
+
+def _w_opt_proposal(w: _Writer, p: Optional[Proposal]) -> None:
+    w.boolean(p is not None)
+    if p is not None:
+        _w_proposal(w, p)
+
+
+def _r_opt_proposal(r: _Reader) -> Optional[Proposal]:
+    return _r_proposal(r) if r.boolean() else None
+
+
+def _w_signature(w: _Writer, s: Signature) -> None:
+    w.u64(s.id)
+    w.blob(s.value)
+    w.blob(s.msg)
+
+
+def _r_signature(r: _Reader) -> Signature:
+    sid = r.u64()
+    value = r.blob()
+    msg = r.blob()
+    return Signature(id=sid, value=value, msg=msg)
+
+
+def _w_view_metadata(w: _Writer, m: ViewMetadata) -> None:
+    w.u64(m.view_id)
+    w.u64(m.latest_sequence)
+    w.u64(m.decisions_in_view)
+    w.seq(m.black_list, w.u64)
+    w.blob(m.prev_commit_signature_digest)
+
+
+def _r_view_metadata(r: _Reader) -> ViewMetadata:
+    view_id = r.u64()
+    latest_sequence = r.u64()
+    decisions_in_view = r.u64()
+    black_list = r.seq(r.u64)
+    digest = r.blob()
+    return ViewMetadata(
+        view_id=view_id,
+        latest_sequence=latest_sequence,
+        decisions_in_view=decisions_in_view,
+        black_list=black_list,
+        prev_commit_signature_digest=digest,
+    )
+
+
+# --- per-message bodies ---------------------------------------------------
+
+
+def _w_pre_prepare(w: _Writer, m: PrePrepare) -> None:
+    w.u64(m.view)
+    w.u64(m.seq)
+    _w_proposal(w, m.proposal)
+    w.seq(m.prev_commit_signatures, lambda s: _w_signature(w, s))
+
+
+def _r_pre_prepare(r: _Reader) -> PrePrepare:
+    view = r.u64()
+    seq = r.u64()
+    proposal = _r_proposal(r)
+    prev_sigs = r.seq(lambda: _r_signature(r))
+    return PrePrepare(
+        view=view, seq=seq, proposal=proposal, prev_commit_signatures=prev_sigs
+    )
+
+
+def _w_prepare(w: _Writer, m: Prepare) -> None:
+    w.u64(m.view)
+    w.u64(m.seq)
+    w.text(m.digest)
+    w.boolean(m.assist)
+
+
+def _r_prepare(r: _Reader) -> Prepare:
+    view = r.u64()
+    seq = r.u64()
+    digest = r.text()
+    assist = r.boolean()
+    return Prepare(view=view, seq=seq, digest=digest, assist=assist)
+
+
+def _w_commit(w: _Writer, m: Commit) -> None:
+    w.u64(m.view)
+    w.u64(m.seq)
+    w.text(m.digest)
+    _w_signature(w, m.signature)
+    w.boolean(m.assist)
+
+
+def _r_commit(r: _Reader) -> Commit:
+    view = r.u64()
+    seq = r.u64()
+    digest = r.text()
+    sig = _r_signature(r)
+    assist = r.boolean()
+    return Commit(view=view, seq=seq, digest=digest, signature=sig, assist=assist)
+
+
+def _w_view_change(w: _Writer, m: ViewChange) -> None:
+    w.u64(m.next_view)
+    w.text(m.reason)
+
+
+def _r_view_change(r: _Reader) -> ViewChange:
+    next_view = r.u64()
+    reason = r.text()
+    return ViewChange(next_view=next_view, reason=reason)
+
+
+def _w_signed_view_data(w: _Writer, m: SignedViewData) -> None:
+    w.blob(m.raw_view_data)
+    w.u64(m.signer)
+    w.blob(m.signature)
+
+
+def _r_signed_view_data(r: _Reader) -> SignedViewData:
+    raw = r.blob()
+    signer = r.u64()
+    sig = r.blob()
+    return SignedViewData(raw_view_data=raw, signer=signer, signature=sig)
+
+
+def _w_new_view(w: _Writer, m: NewView) -> None:
+    w.seq(m.signed_view_data, lambda s: _w_signed_view_data(w, s))
+
+
+def _r_new_view(r: _Reader) -> NewView:
+    return NewView(signed_view_data=r.seq(lambda: _r_signed_view_data(r)))
+
+
+def _w_heart_beat(w: _Writer, m: HeartBeat) -> None:
+    w.u64(m.view)
+    w.u64(m.seq)
+
+
+def _r_heart_beat(r: _Reader) -> HeartBeat:
+    view = r.u64()
+    seq = r.u64()
+    return HeartBeat(view=view, seq=seq)
+
+
+def _w_heart_beat_response(w: _Writer, m: HeartBeatResponse) -> None:
+    w.u64(m.view)
+
+
+def _r_heart_beat_response(r: _Reader) -> HeartBeatResponse:
+    return HeartBeatResponse(view=r.u64())
+
+
+def _w_str(w: _Writer, m: StateTransferRequest) -> None:
+    pass
+
+
+def _r_str(r: _Reader) -> StateTransferRequest:
+    return StateTransferRequest()
+
+
+def _w_sts(w: _Writer, m: StateTransferResponse) -> None:
+    w.u64(m.view_num)
+    w.u64(m.sequence)
+
+
+def _r_sts(r: _Reader) -> StateTransferResponse:
+    view_num = r.u64()
+    sequence = r.u64()
+    return StateTransferResponse(view_num=view_num, sequence=sequence)
+
+
+# Tag assignments mirror the reference's oneof field numbers
+# (smartbftprotos/messages.proto:15-26) for easy cross-auditing.
+_MESSAGE_CODECS: dict[int, tuple[type, Callable, Callable]] = {
+    1: (PrePrepare, _w_pre_prepare, _r_pre_prepare),
+    2: (Prepare, _w_prepare, _r_prepare),
+    3: (Commit, _w_commit, _r_commit),
+    4: (ViewChange, _w_view_change, _r_view_change),
+    5: (SignedViewData, _w_signed_view_data, _r_signed_view_data),
+    6: (NewView, _w_new_view, _r_new_view),
+    7: (HeartBeat, _w_heart_beat, _r_heart_beat),
+    8: (HeartBeatResponse, _w_heart_beat_response, _r_heart_beat_response),
+    9: (StateTransferRequest, _w_str, _r_str),
+    10: (StateTransferResponse, _w_sts, _r_sts),
+}
+
+_TAG_BY_TYPE = {cls: tag for tag, (cls, _, _) in _MESSAGE_CODECS.items()}
+
+
+def encode_message(msg: ConsensusMessage) -> bytes:
+    """Serialize a consensus message to self-delimiting bytes."""
+    tag = _TAG_BY_TYPE.get(type(msg))
+    if tag is None:
+        raise CodecError(f"not a wire message: {type(msg).__name__}")
+    w = _Writer()
+    w.u8(_VERSION)
+    w.u8(_DOMAIN_WIRE)
+    w.u8(tag)
+    _MESSAGE_CODECS[tag][1](w, msg)
+    return w.getvalue()
+
+
+def decode_message(buf: bytes) -> ConsensusMessage:
+    """Parse bytes produced by :func:`encode_message`."""
+    r = _Reader(buf)
+    version = r.u8()
+    if version != _VERSION:
+        raise CodecError(f"unsupported codec version {version}")
+    if r.u8() != _DOMAIN_WIRE:
+        raise CodecError("not a wire-message encoding (wrong domain byte)")
+    tag = r.u8()
+    entry = _MESSAGE_CODECS.get(tag)
+    if entry is None:
+        raise CodecError(f"unknown message tag {tag}")
+    msg = entry[2](r)
+    r.expect_end()
+    return msg
+
+
+# --- ViewData (signed payload, not a top-level wire message) --------------
+
+
+def encode_view_data(vd: ViewData) -> bytes:
+    """Serialize ViewData — these bytes are what gets signed and embedded in
+    ``SignedViewData.raw_view_data`` (reference viewchanger.go:433-456)."""
+    w = _Writer()
+    w.u8(_VERSION)
+    w.u64(vd.next_view)
+    _w_opt_proposal(w, vd.last_decision)
+    w.seq(vd.last_decision_signatures, lambda s: _w_signature(w, s))
+    _w_opt_proposal(w, vd.in_flight_proposal)
+    w.boolean(vd.in_flight_prepared)
+    return w.getvalue()
+
+
+def decode_view_data(buf: bytes) -> ViewData:
+    r = _Reader(buf)
+    version = r.u8()
+    if version != _VERSION:
+        raise CodecError(f"unsupported codec version {version}")
+    next_view = r.u64()
+    last_decision = _r_opt_proposal(r)
+    last_sigs = r.seq(lambda: _r_signature(r))
+    in_flight = _r_opt_proposal(r)
+    prepared = r.boolean()
+    r.expect_end()
+    return ViewData(
+        next_view=next_view,
+        last_decision=last_decision,
+        last_decision_signatures=last_sigs,
+        in_flight_proposal=in_flight,
+        in_flight_prepared=prepared,
+    )
+
+
+def encode_prepares_from(pf: PreparesFrom) -> bytes:
+    """Serialize the prepare-sender vouch list (commit signature aux data)."""
+    w = _Writer()
+    w.u8(_VERSION)
+    w.seq(pf.ids, w.u64)
+    return w.getvalue()
+
+
+def decode_prepares_from(buf: bytes) -> PreparesFrom:
+    r = _Reader(buf)
+    version = r.u8()
+    if version != _VERSION:
+        raise CodecError(f"unsupported codec version {version}")
+    ids = r.seq(r.u64)
+    r.expect_end()
+    return PreparesFrom(ids=ids)
+
+
+def encode_view_metadata(m: ViewMetadata) -> bytes:
+    """Serialize ViewMetadata — stamped into ``Proposal.metadata``."""
+    w = _Writer()
+    w.u8(_VERSION)
+    _w_view_metadata(w, m)
+    return w.getvalue()
+
+
+def decode_view_metadata(buf: bytes) -> ViewMetadata:
+    r = _Reader(buf)
+    version = r.u8()
+    if version != _VERSION:
+        raise CodecError(f"unsupported codec version {version}")
+    m = _r_view_metadata(r)
+    r.expect_end()
+    return m
+
+
+# --- SavedMessage (WAL records) ------------------------------------------
+
+
+def _w_proposed_record(w: _Writer, m: ProposedRecord) -> None:
+    _w_pre_prepare(w, m.pre_prepare)
+    _w_prepare(w, m.prepare)
+
+
+def _r_proposed_record(r: _Reader) -> ProposedRecord:
+    pp = _r_pre_prepare(r)
+    p = _r_prepare(r)
+    return ProposedRecord(pre_prepare=pp, prepare=p)
+
+
+def _w_saved_commit(w: _Writer, m: SavedCommit) -> None:
+    _w_commit(w, m.commit)
+
+
+def _r_saved_commit(r: _Reader) -> SavedCommit:
+    return SavedCommit(commit=_r_commit(r))
+
+
+def _w_saved_new_view(w: _Writer, m: SavedNewView) -> None:
+    _w_view_metadata(w, m.view_metadata)
+
+
+def _r_saved_new_view(r: _Reader) -> SavedNewView:
+    return SavedNewView(view_metadata=_r_view_metadata(r))
+
+
+def _w_saved_view_change(w: _Writer, m: SavedViewChange) -> None:
+    _w_view_change(w, m.view_change)
+
+
+def _r_saved_view_change(r: _Reader) -> SavedViewChange:
+    return SavedViewChange(view_change=_r_view_change(r))
+
+
+# Tags mirror the SavedMessage oneof (smartbftprotos/messages.proto:113-120).
+_SAVED_CODECS: dict[int, tuple[type, Callable, Callable]] = {
+    1: (ProposedRecord, _w_proposed_record, _r_proposed_record),
+    2: (SavedCommit, _w_saved_commit, _r_saved_commit),
+    3: (SavedNewView, _w_saved_new_view, _r_saved_new_view),
+    4: (SavedViewChange, _w_saved_view_change, _r_saved_view_change),
+}
+
+_SAVED_TAG_BY_TYPE = {cls: tag for tag, (cls, _, _) in _SAVED_CODECS.items()}
+
+
+def encode_saved(msg: SavedMessage) -> bytes:
+    """Serialize a WAL record."""
+    tag = _SAVED_TAG_BY_TYPE.get(type(msg))
+    if tag is None:
+        raise CodecError(f"not a saved message: {type(msg).__name__}")
+    w = _Writer()
+    w.u8(_VERSION)
+    w.u8(_DOMAIN_SAVED)
+    w.u8(tag)
+    _SAVED_CODECS[tag][1](w, msg)
+    return w.getvalue()
+
+
+def decode_saved(buf: bytes) -> SavedMessage:
+    """Parse bytes produced by :func:`encode_saved`."""
+    r = _Reader(buf)
+    version = r.u8()
+    if version != _VERSION:
+        raise CodecError(f"unsupported codec version {version}")
+    if r.u8() != _DOMAIN_SAVED:
+        raise CodecError("not a WAL-record encoding (wrong domain byte)")
+    tag = r.u8()
+    entry = _SAVED_CODECS.get(tag)
+    if entry is None:
+        raise CodecError(f"unknown saved-message tag {tag}")
+    msg = entry[2](r)
+    r.expect_end()
+    return msg
+
+
+__all__ = [
+    "CodecError",
+    "encode_message",
+    "decode_message",
+    "encode_view_data",
+    "decode_view_data",
+    "encode_prepares_from",
+    "decode_prepares_from",
+    "encode_view_metadata",
+    "decode_view_metadata",
+    "encode_saved",
+    "decode_saved",
+]

@@ -1,0 +1,299 @@
+"""Consensus wire messages and WAL record types.
+
+Parity: reference smartbftprotos/messages.proto:14-128 — the ``Message`` oneof
+with 10 consensus message kinds, the ``SavedMessage`` oneof with 4 persisted
+record kinds, plus ``ViewMetadata`` and ``PreparesFrom``.
+
+These are plain frozen dataclasses; serialization lives in
+:mod:`consensus_tpu.wire.codec` (a deterministic binary TLV codec — byte
+compatibility with the Go protobuf wire is a non-goal, shape compatibility
+is).  Sender identity travels *outside* the message, exactly like the
+reference's ``HandleMessage(sender, msg)``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Union
+
+from consensus_tpu.types import Proposal, Signature
+
+
+@dataclass(frozen=True)
+class ViewMetadata:
+    """Leader-stamped proposal metadata binding a proposal to its place in the
+    protocol and carrying the rotation blacklist.
+
+    Parity: reference smartbftprotos/messages.proto:103-109.
+    """
+
+    view_id: int = 0
+    latest_sequence: int = 0
+    decisions_in_view: int = 0
+    black_list: tuple[int, ...] = ()
+    prev_commit_signature_digest: bytes = b""
+
+
+@dataclass(frozen=True)
+class PrePrepare:
+    """Leader's phase-1 proposal broadcast.
+
+    ``prev_commit_signatures`` carries the quorum that committed the previous
+    proposal — followers verify them and the blacklist update they imply.
+    Parity: reference smartbftprotos/messages.proto:29-34.
+    """
+
+    view: int
+    seq: int
+    proposal: Proposal
+    prev_commit_signatures: tuple[Signature, ...] = ()
+
+
+@dataclass(frozen=True)
+class Prepare:
+    """Phase-2 echo of the proposal digest.
+
+    ``assist`` marks retransmission-help replies that must not be re-answered
+    (reference smartbftprotos/messages.proto:40).
+    Parity: reference smartbftprotos/messages.proto:36-41.
+    """
+
+    view: int
+    seq: int
+    digest: str
+    assist: bool = False
+
+
+@dataclass(frozen=True)
+class Commit:
+    """Phase-3 vote carrying the voter's signature over the proposal.
+
+    Parity: reference smartbftprotos/messages.proto:48-54.
+    """
+
+    view: int
+    seq: int
+    digest: str
+    signature: Signature
+    assist: bool = False
+
+
+@dataclass(frozen=True)
+class PreparesFrom:
+    """The prepare-sender id list a consenter vouches for inside its commit
+    signature's auxiliary payload (blacklist redemption voting).
+
+    Parity: reference smartbftprotos/messages.proto:56-58.
+    """
+
+    ids: tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class ViewChange:
+    """Vote to abandon the current view.
+
+    Parity: reference smartbftprotos/messages.proto:60-63.
+    """
+
+    next_view: int
+    reason: str = ""
+
+
+@dataclass(frozen=True)
+class ViewData:
+    """A replica's signed account of its state, sent to the next leader.
+
+    Parity: reference smartbftprotos/messages.proto:65-71.
+    """
+
+    next_view: int
+    last_decision: Optional[Proposal] = None
+    last_decision_signatures: tuple[Signature, ...] = ()
+    in_flight_proposal: Optional[Proposal] = None
+    in_flight_prepared: bool = False
+
+
+@dataclass(frozen=True)
+class SignedViewData:
+    """ViewData as signed raw bytes + the signer's identity.
+
+    Parity: reference smartbftprotos/messages.proto:73-77.
+    """
+
+    raw_view_data: bytes
+    signer: int
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class NewView:
+    """New leader's proof: a quorum of SignedViewData.
+
+    Parity: reference smartbftprotos/messages.proto:79-81.
+    """
+
+    signed_view_data: tuple[SignedViewData, ...] = ()
+
+
+@dataclass(frozen=True)
+class HeartBeat:
+    """Leader liveness beacon carrying its current (view, seq).
+
+    Parity: reference smartbftprotos/messages.proto:83-86.
+    """
+
+    view: int
+    seq: int = 0
+
+
+@dataclass(frozen=True)
+class HeartBeatResponse:
+    """Follower's answer to a stale-view heartbeat (tells the leader the
+    cluster moved on).  Parity: reference smartbftprotos/messages.proto:88-90.
+    """
+
+    view: int
+
+
+@dataclass(frozen=True)
+class StateTransferRequest:
+    """Ask peers for their current (view, seq).
+
+    Parity: reference smartbftprotos/messages.proto:122-123.
+    """
+
+
+@dataclass(frozen=True)
+class StateTransferResponse:
+    """Answer to a state-transfer request.
+
+    Parity: reference smartbftprotos/messages.proto:126-128.
+    """
+
+    view_num: int
+    sequence: int
+
+
+#: The "Message oneof": anything a replica may put on the wire.
+ConsensusMessage = Union[
+    PrePrepare,
+    Prepare,
+    Commit,
+    ViewChange,
+    SignedViewData,
+    NewView,
+    HeartBeat,
+    HeartBeatResponse,
+    StateTransferRequest,
+    StateTransferResponse,
+]
+
+
+# --- WAL record kinds ----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ProposedRecord:
+    """WAL record: a proposal was accepted and a prepare is about to be sent.
+
+    Parity: reference smartbftprotos/messages.proto:43-46.
+    """
+
+    pre_prepare: PrePrepare
+    prepare: Prepare
+
+
+@dataclass(frozen=True)
+class SavedCommit:
+    """WAL record: a prepared quorum was reached and a commit is about to be
+    sent.  Wraps the commit message itself (the reference stores the whole
+    ``Message``; we store the ``Commit`` directly).
+
+    Parity: reference smartbftprotos/messages.proto:113-116 (``commit`` arm).
+    """
+
+    commit: Commit
+
+
+@dataclass(frozen=True)
+class SavedNewView:
+    """WAL record: a new view was finalized; stores the restore point.
+
+    Parity: reference smartbftprotos/messages.proto:117 (``new_view`` arm —
+    a ViewMetadata).
+    """
+
+    view_metadata: ViewMetadata
+
+
+@dataclass(frozen=True)
+class SavedViewChange:
+    """WAL record: we voted to leave a view.
+
+    Parity: reference smartbftprotos/messages.proto:118 (``view_change`` arm).
+    """
+
+    view_change: ViewChange
+
+
+#: The "SavedMessage oneof": anything persisted to the WAL.
+SavedMessage = Union[ProposedRecord, SavedCommit, SavedNewView, SavedViewChange]
+
+
+def msg_to_string(msg: ConsensusMessage) -> str:
+    """Compact human-readable rendering for logs.
+
+    Parity: reference internal/bft/util.go:345-420 (MsgToString).
+    """
+    if isinstance(msg, PrePrepare):
+        return (
+            f"<PrePrepare view={msg.view} seq={msg.seq} "
+            f"digest={msg.proposal.digest()[:8]}>"
+        )
+    if isinstance(msg, Prepare):
+        return f"<Prepare view={msg.view} seq={msg.seq} digest={msg.digest[:8]} assist={msg.assist}>"
+    if isinstance(msg, Commit):
+        return (
+            f"<Commit view={msg.view} seq={msg.seq} digest={msg.digest[:8]} "
+            f"signer={msg.signature.id} assist={msg.assist}>"
+        )
+    if isinstance(msg, ViewChange):
+        return f"<ViewChange next_view={msg.next_view} reason={msg.reason!r}>"
+    if isinstance(msg, SignedViewData):
+        return f"<SignedViewData signer={msg.signer}>"
+    if isinstance(msg, NewView):
+        return f"<NewView n={len(msg.signed_view_data)}>"
+    if isinstance(msg, HeartBeat):
+        return f"<HeartBeat view={msg.view} seq={msg.seq}>"
+    if isinstance(msg, HeartBeatResponse):
+        return f"<HeartBeatResponse view={msg.view}>"
+    if isinstance(msg, StateTransferRequest):
+        return "<StateTransferRequest>"
+    if isinstance(msg, StateTransferResponse):
+        return f"<StateTransferResponse view={msg.view_num} seq={msg.sequence}>"
+    return repr(msg)
+
+
+__all__ = [
+    "ViewMetadata",
+    "PrePrepare",
+    "Prepare",
+    "Commit",
+    "PreparesFrom",
+    "ViewChange",
+    "ViewData",
+    "SignedViewData",
+    "NewView",
+    "HeartBeat",
+    "HeartBeatResponse",
+    "StateTransferRequest",
+    "StateTransferResponse",
+    "ConsensusMessage",
+    "ProposedRecord",
+    "SavedCommit",
+    "SavedNewView",
+    "SavedViewChange",
+    "SavedMessage",
+    "msg_to_string",
+]
