@@ -1,0 +1,17 @@
+"""Deterministic clock + event scheduler (the replica concurrency model)."""
+
+from consensus_tpu.runtime.scheduler import (
+    Clock,
+    RealtimeScheduler,
+    Scheduler,
+    SimScheduler,
+    TimerHandle,
+)
+
+__all__ = [
+    "Clock",
+    "Scheduler",
+    "SimScheduler",
+    "RealtimeScheduler",
+    "TimerHandle",
+]

@@ -1,0 +1,22 @@
+"""Segmented CRC-chained write-ahead log (crash recovery substrate).
+
+Parity: reference pkg/wal/.
+"""
+
+from consensus_tpu.wal.log import (
+    DEFAULT_SEGMENT_MAX_BYTES,
+    CorruptLogError,
+    WALError,
+    WriteAheadLog,
+    initialize_and_read_all,
+    repair,
+)
+
+__all__ = [
+    "WriteAheadLog",
+    "WALError",
+    "CorruptLogError",
+    "repair",
+    "initialize_and_read_all",
+    "DEFAULT_SEGMENT_MAX_BYTES",
+]

@@ -1,0 +1,364 @@
+"""Segmented, CRC-chained, fsync-on-append write-ahead log.
+
+Parity: reference pkg/wal/writeaheadlog.go:60-810 — same guarantees, fresh
+layout:
+
+* **Append durability** — every ``append`` writes one framed record and
+  fsyncs before returning (the protocol persists *before* broadcasting, so a
+  crashed replica can never have said something it doesn't remember).
+* **Chained CRC** — each record's checksum covers its payload *and* the
+  previous record's checksum, so silent mid-stream corruption or record
+  reordering breaks the chain (reference chains CRC32-Castagnoli the same
+  way; stdlib CRC-32 here — the polynomial is an implementation detail).
+* **Segmented files** — the log rolls to a new segment at
+  ``segment_max_bytes``; each segment opens with an anchor record carrying
+  the running CRC so any segment is independently readable
+  (reference CRC_ANCHOR, pkg/wal/logrecord.proto).
+* **Truncation** — ``append(..., truncate_to=True)`` marks the record as a
+  stable restore point: all *older segments* are deleted (the current one is
+  kept), bounding disk use (reference writeaheadlog.go:661-708).
+* **Repair** — a torn tail (crash mid-write) is detected by ``read_all`` and
+  chopped off by ``repair``, which truncates after the last intact record
+  (reference writeaheadlog.go:293-337).
+
+Record frame (all integers little-endian):
+
+    u32 payload_length | u32 crc | payload | zero padding to 8-byte multiple
+
+Payload = 1 type byte (ENTRY / ANCHOR) + 1 flag byte (truncate_to) + data.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+import zlib
+from typing import Optional
+
+_HEADER = struct.Struct("<II")
+_TYPE_ENTRY = 0x01
+_TYPE_ANCHOR = 0x02
+_FLAG_TRUNCATE_TO = 0x01
+
+_SEGMENT_RE = re.compile(r"^(\d{16})\.wal$")
+
+DEFAULT_SEGMENT_MAX_BYTES = 64 * 1024 * 1024
+_INITIAL_CRC = 0
+
+
+class WALError(Exception):
+    """Base class for WAL failures."""
+
+
+class CorruptLogError(WALError):
+    """The log fails CRC/framing validation.
+
+    ``segment`` / ``offset`` locate the first bad byte so ``repair`` can
+    truncate there; ``entries`` holds everything intact before the fault.
+    """
+
+    def __init__(self, msg: str, *, segment: str, offset: int, entries: list[bytes]):
+        super().__init__(f"{msg} (segment={segment!r}, offset={offset})")
+        self.segment = segment
+        self.offset = offset
+        self.entries = entries
+
+
+def _pad(n: int) -> int:
+    return (8 - n % 8) % 8
+
+
+def _segment_name(index: int) -> str:
+    return f"{index:016d}.wal"
+
+
+def _list_segments(directory: str) -> list[tuple[int, str]]:
+    out = []
+    for name in os.listdir(directory):
+        m = _SEGMENT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), name))
+    out.sort()
+    return out
+
+
+def _fsync_dir(directory: str) -> None:
+    fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class WriteAheadLog:
+    """Append-only log over a directory of segment files.
+
+    Use :func:`create` for a fresh directory, :func:`open_` for an existing
+    one, or :func:`initialize_and_read_all` for the boot-time "create or
+    open+repair+read" flow (reference pkg/wal/writeaheadlog.go:754-810).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        segment_max_bytes: int = DEFAULT_SEGMENT_MAX_BYTES,
+        sync: bool = True,
+    ) -> None:
+        self._dir = directory
+        self._segment_max_bytes = segment_max_bytes
+        self._sync = sync
+        self._file: Optional[object] = None  # io.BufferedWriter
+        self._segment_index = 0
+        self._crc = _INITIAL_CRC
+        self._closed = False
+        #: Entries found by :func:`open_`'s validation scan (None for a
+        #: freshly created log) — lets boot avoid a second full-disk read.
+        self.entries_at_open: Optional[list[bytes]] = None
+
+    # --- construction ------------------------------------------------------
+
+    @classmethod
+    def create(cls, directory: str, **kw) -> "WriteAheadLog":
+        """Create a brand-new log; the directory must be empty or absent.
+
+        Parity: reference pkg/wal/writeaheadlog.go:125-205.
+        """
+        os.makedirs(directory, exist_ok=True)
+        if _list_segments(directory):
+            raise WALError(f"directory {directory!r} already contains a WAL")
+        wal = cls(directory, **kw)
+        wal._start_segment(1)
+        return wal
+
+    @classmethod
+    def open_(cls, directory: str, **kw) -> "WriteAheadLog":
+        """Open an existing log for appending after the last intact record.
+
+        Raises :class:`CorruptLogError` if the tail is torn — call
+        :func:`repair` first.  Parity: reference writeaheadlog.go:207-291.
+        """
+        segments = _list_segments(directory)
+        if not segments:
+            raise WALError(f"no WAL in {directory!r}")
+        wal = cls(directory, **kw)
+        # Validate everything (raises CorruptLogError on damage) and leave
+        # the chain CRC positioned after the final record.  The entries are
+        # kept so boot (initialize_and_read_all) doesn't rescan the disk.
+        wal.entries_at_open = wal._scan_all()
+        last_index, last_name = segments[-1]
+        path = os.path.join(directory, last_name)
+        wal._file = open(path, "ab")
+        wal._segment_index = last_index
+        return wal
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+            self._file.close()
+            self._file = None
+        self._closed = True
+
+    # --- appending ---------------------------------------------------------
+
+    def append(self, data: bytes, truncate_to: bool = False) -> None:
+        """Durably append one record; returns after fsync.
+
+        ``truncate_to=True`` marks a stable restore point and deletes all
+        older segments.  Parity: reference writeaheadlog.go:403-497.
+        """
+        if self._closed or self._file is None:
+            raise WALError("log is closed")
+        flags = _FLAG_TRUNCATE_TO if truncate_to else 0
+        self._write_record(_TYPE_ENTRY, flags, data)
+        if truncate_to:
+            self._drop_old_segments()
+        if self._file.tell() >= self._segment_max_bytes:
+            self._start_segment(self._segment_index + 1)
+
+    def _write_record(self, rtype: int, flags: int, data: bytes) -> None:
+        payload = bytes([rtype, flags]) + data
+        self._crc = zlib.crc32(payload, self._crc) & 0xFFFFFFFF
+        frame = _HEADER.pack(len(payload), self._crc) + payload + b"\x00" * _pad(
+            len(payload)
+        )
+        self._file.write(frame)
+        self._file.flush()
+        if self._sync:
+            os.fsync(self._file.fileno())
+
+    def _start_segment(self, index: int) -> None:
+        if self._file is not None:
+            self._file.flush()
+            if self._sync:
+                os.fsync(self._file.fileno())
+            self._file.close()
+        path = os.path.join(self._dir, _segment_name(index))
+        self._file = open(path, "ab")
+        self._segment_index = index
+        # Anchor: carries the running chain CRC so this segment can be
+        # validated without its predecessors.
+        anchor_data = struct.pack("<I", self._crc)
+        self._write_record(_TYPE_ANCHOR, 0, anchor_data)
+        if self._sync:
+            _fsync_dir(self._dir)
+
+    def _drop_old_segments(self) -> None:
+        for index, name in _list_segments(self._dir):
+            if index < self._segment_index:
+                os.unlink(os.path.join(self._dir, name))
+        if self._sync:
+            _fsync_dir(self._dir)
+
+    # --- reading -----------------------------------------------------------
+
+    def read_all(self) -> list[bytes]:
+        """All intact entry payloads, oldest first.
+
+        Raises :class:`CorruptLogError` on a broken chain or torn tail.
+        Parity: reference writeaheadlog.go:510-602.
+        """
+        return self._scan_all()
+
+    def _scan_all(self) -> list[bytes]:
+        entries: list[bytes] = []
+        crc = _INITIAL_CRC
+        first = True
+        for _, name in _list_segments(self._dir):
+            path = os.path.join(self._dir, name)
+            with open(path, "rb") as f:
+                buf = f.read()
+            crc, first = self._scan_segment(name, buf, crc, first, entries)
+        self._crc = crc
+        return entries
+
+    def _scan_segment(
+        self,
+        name: str,
+        buf: bytes,
+        crc: int,
+        first_segment: bool,
+        entries: list[bytes],
+    ) -> tuple[int, bool]:
+        off = 0
+        first_record = True
+        while off < len(buf):
+            if off + _HEADER.size > len(buf):
+                raise CorruptLogError(
+                    "torn frame header", segment=name, offset=off, entries=entries
+                )
+            length, want_crc = _HEADER.unpack_from(buf, off)
+            body_start = off + _HEADER.size
+            body_end = body_start + length
+            if length < 2 or body_end + _pad(length) > len(buf):
+                raise CorruptLogError(
+                    "torn frame payload", segment=name, offset=off, entries=entries
+                )
+            payload = buf[body_start:body_end]
+            rtype, flags = payload[0], payload[1]
+            if first_record:
+                # Every segment must open with an anchor matching the chain.
+                if rtype != _TYPE_ANCHOR:
+                    raise CorruptLogError(
+                        "segment missing anchor", segment=name, offset=off, entries=entries
+                    )
+                anchor_crc = struct.unpack("<I", payload[2:6])[0]
+                if not first_segment and anchor_crc != crc:
+                    raise CorruptLogError(
+                        "anchor breaks CRC chain", segment=name, offset=off, entries=entries
+                    )
+                crc = anchor_crc  # trust the anchor when this is the oldest kept segment
+                first_record = False
+            got = zlib.crc32(payload, crc) & 0xFFFFFFFF
+            if got != want_crc:
+                raise CorruptLogError(
+                    "CRC mismatch", segment=name, offset=off, entries=entries
+                )
+            crc = got
+            if rtype == _TYPE_ENTRY:
+                if flags & _FLAG_TRUNCATE_TO:
+                    # A stable restore point retires everything before it,
+                    # including earlier records in this same segment (older
+                    # segments were already deleted at append time).
+                    # Parity: reference pkg/wal/writeaheadlog.go:549-551.
+                    entries.clear()
+                entries.append(payload[2:])
+            elif rtype != _TYPE_ANCHOR:
+                raise CorruptLogError(
+                    f"unknown record type {rtype}", segment=name, offset=off, entries=entries
+                )
+            off = body_end + _pad(length)
+        if first_record:
+            raise CorruptLogError(
+                "empty segment", segment=name, offset=0, entries=entries
+            )
+        return crc, False
+
+
+def repair(directory: str) -> None:
+    """Chop a torn tail: truncate the damaged segment after its last intact
+    record (taking a ``.bak`` copy first) and delete any later segments.
+
+    Parity: reference pkg/wal/writeaheadlog.go:293-337.
+    """
+    probe = WriteAheadLog(directory)
+    try:
+        probe._scan_all()
+        return  # nothing to repair
+    except CorruptLogError as err:
+        bad_segment, offset = err.segment, err.offset
+
+    segments = _list_segments(directory)
+    path = os.path.join(directory, bad_segment)
+    backup = path + ".bak"
+    with open(path, "rb") as src, open(backup, "wb") as dst:
+        dst.write(src.read())
+        dst.flush()
+        os.fsync(dst.fileno())
+    if offset == 0:
+        # Nothing salvageable in this segment: remove it entirely.
+        os.unlink(path)
+    else:
+        with open(path, "r+b") as f:
+            f.truncate(offset)
+            f.flush()
+            os.fsync(f.fileno())
+    # Anything after the damaged segment is unreachable through the chain.
+    bad_index = int(_SEGMENT_RE.match(bad_segment).group(1))
+    for index, name in segments:
+        if index > bad_index:
+            os.unlink(os.path.join(directory, name))
+    _fsync_dir(directory)
+
+
+def initialize_and_read_all(
+    directory: str, **kw
+) -> tuple[WriteAheadLog, list[bytes]]:
+    """Boot-time flow: create a fresh log, or open an existing one (repairing
+    a torn tail if needed) and return its entries.
+
+    Parity: reference pkg/wal/writeaheadlog.go:754-810.
+    """
+    os.makedirs(directory, exist_ok=True)
+    if not _list_segments(directory):
+        return WriteAheadLog.create(directory, **kw), []
+    try:
+        wal = WriteAheadLog.open_(directory, **kw)
+    except CorruptLogError:
+        repair(directory)
+        if not _list_segments(directory):
+            # The only segment was damaged beyond its anchor: start fresh.
+            return WriteAheadLog.create(directory, **kw), []
+        wal = WriteAheadLog.open_(directory, **kw)
+    return wal, wal.entries_at_open if wal.entries_at_open is not None else []
+
+
+__all__ = [
+    "WriteAheadLog",
+    "WALError",
+    "CorruptLogError",
+    "repair",
+    "initialize_and_read_all",
+    "DEFAULT_SEGMENT_MAX_BYTES",
+]

@@ -1,0 +1,136 @@
+"""Tests for the deterministic scheduler — ordering, cancellation, virtual
+time, and the realtime variant's thread handoff."""
+
+import threading
+
+import pytest
+
+from consensus_tpu.runtime import RealtimeScheduler, SimScheduler
+
+
+def test_same_time_events_fire_in_scheduling_order():
+    s = SimScheduler()
+    out = []
+    s.call_later(1.0, lambda: out.append("a"))
+    s.call_later(1.0, lambda: out.append("b"))
+    s.call_later(0.5, lambda: out.append("first"))
+    s.run_until_idle()
+    assert out == ["first", "a", "b"]
+    assert s.now() == 1.0
+
+
+def test_advance_runs_only_due_events_and_moves_clock_exactly():
+    s = SimScheduler()
+    out = []
+    s.call_later(1.0, lambda: out.append(1))
+    s.call_later(2.0, lambda: out.append(2))
+    n = s.advance(1.5)
+    assert n == 1 and out == [1]
+    assert s.now() == 1.5
+    s.advance(0.5)
+    assert out == [1, 2] and s.now() == 2.0
+
+
+def test_cancel_prevents_firing():
+    s = SimScheduler()
+    out = []
+    h = s.call_later(1.0, lambda: out.append("x"))
+    s.call_later(2.0, lambda: out.append("y"))
+    h.cancel()
+    assert h.cancelled
+    s.run_until_idle()
+    assert out == ["y"]
+
+
+def test_handler_reschedules_itself():
+    s = SimScheduler()
+    ticks = []
+
+    def tick():
+        ticks.append(s.now())
+        if len(ticks) < 3:
+            s.call_later(10.0, tick)
+
+    s.call_later(10.0, tick)
+    s.run_until_idle()
+    assert ticks == [10.0, 20.0, 30.0]
+
+
+def test_post_runs_at_current_time_in_fifo_order():
+    s = SimScheduler(start=5.0)
+    out = []
+    s.post(lambda: out.append("a"))
+    s.post(lambda: out.append("b"))
+    s.run_until_idle()
+    assert out == ["a", "b"]
+    assert s.now() == 5.0  # zero-delay events don't move time
+
+
+def test_run_until_predicate():
+    s = SimScheduler()
+    out = []
+    for i in range(10):
+        s.call_later(float(i), lambda i=i: out.append(i))
+    assert s.run_until(lambda: len(out) == 3)
+    assert out == [0, 1, 2]
+    assert not s.run_until(lambda: len(out) == 99, max_time=100.0)
+
+
+def test_exception_in_handler_does_not_stop_the_world():
+    s = SimScheduler()
+    out = []
+    s.call_later(1.0, lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    s.call_later(2.0, lambda: out.append("survived"))
+    s.run_until_idle()
+    assert out == ["survived"]
+
+
+def test_livelock_guard():
+    s = SimScheduler()
+
+    def forever():
+        s.post(forever)
+
+    s.post(forever)
+    with pytest.raises(RuntimeError):
+        s.run_until_idle(max_events=100)
+
+
+def test_determinism_across_runs():
+    def scenario():
+        s = SimScheduler()
+        out = []
+        s.call_later(1.0, lambda: (out.append("t1"), s.post(lambda: out.append("p1"))))
+        s.call_later(1.0, lambda: out.append("t2"))
+        s.call_later(0.5, lambda: s.call_later(0.5, lambda: out.append("nested")))
+        s.run_until_idle()
+        return out
+
+    assert scenario() == scenario()
+
+
+def test_realtime_scheduler_executes_on_worker_thread():
+    rt = RealtimeScheduler()
+    rt.start()
+    try:
+        done = threading.Event()
+        seen = {}
+
+        def job():
+            seen["thread"] = threading.current_thread().name
+            done.set()
+
+        rt.post(job)
+        assert done.wait(timeout=5.0)
+        assert seen["thread"] == "consensus-runtime"
+
+        # Delayed + cancelled timers.
+        fired = threading.Event()
+        h = rt.call_later(30.0, fired.set)
+        h.cancel()
+        done2 = threading.Event()
+        rt.call_later(0.01, done2.set)
+        assert done2.wait(timeout=5.0)
+        assert not fired.is_set()
+    finally:
+        rt.stop()
