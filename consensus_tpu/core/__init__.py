@@ -1,0 +1,11 @@
+"""The consensus protocol state machines (single-threaded, event-driven)."""
+
+from consensus_tpu.core.batcher import Batcher
+from consensus_tpu.core.pool import PoolOptions, RequestPool, RequestTimeoutHandler
+
+__all__ = [
+    "RequestPool",
+    "PoolOptions",
+    "RequestTimeoutHandler",
+    "Batcher",
+]

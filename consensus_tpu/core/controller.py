@@ -1,0 +1,655 @@
+"""The Controller: owns the current View, routes messages, runs leader
+duties, drives sync, and anchors failure detection.
+
+Parity: reference internal/bft/controller.go (965 LoC).  Structural
+deviations, all consequences of the single-threaded runtime:
+
+* The reference's channel plumbing (``decisionChan`` / ``deliverChan`` /
+  ``leaderToken`` / ``syncChan``, controller.go:489-526) collapses into plain
+  method calls and scheduler posts — the View calls ``decide`` synchronously,
+  and delivery happens inline before the next message is processed, which is
+  exactly the serialization ``MutuallyExclusiveDeliver`` + ``deliverChan``
+  reconstruct with locks (controller.go:873-890, 928-965).  The
+  sequence-already-synced guard inside the reference's wrapper is kept
+  (``_deliver_checked``).
+* The leader token (controller.go:748-761) becomes a boolean + a scheduled
+  ``_propose`` continuation; the batcher hands batches back via callback.
+* ``sync()`` (controller.go:576-680) becomes a state-machine step chain:
+  synchronizer → state-fetch window (collector callback) → view math.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Protocol, Sequence
+
+from consensus_tpu.api.deps import (
+    Application,
+    Assembler,
+    Comm,
+    Signer,
+    Synchronizer,
+    Verifier,
+)
+from consensus_tpu.config import Configuration
+from consensus_tpu.core.batcher import Batcher
+from consensus_tpu.core.collector import StateCollector
+from consensus_tpu.core.heartbeat import HeartbeatMonitor, Role
+from consensus_tpu.core.pool import RequestPool
+from consensus_tpu.core.state import InFlightData, PersistedState, ProposalMaker
+from consensus_tpu.core.view import Phase, View
+from consensus_tpu.runtime.scheduler import Scheduler
+from consensus_tpu.types import Checkpoint, Proposal, Reconfig, RequestInfo, Signature
+from consensus_tpu.utils.leader import get_leader_id
+from consensus_tpu.utils.quorum import compute_quorum
+from consensus_tpu.wire import (
+    Commit,
+    ConsensusMessage,
+    HeartBeat,
+    HeartBeatResponse,
+    NewView,
+    PrePrepare,
+    Prepare,
+    SavedNewView,
+    SignedViewData,
+    StateTransferRequest,
+    StateTransferResponse,
+    ViewChange,
+    ViewMetadata,
+    decode_view_metadata,
+    msg_to_string,
+)
+
+logger = logging.getLogger("consensus_tpu.controller")
+
+
+class ViewChangerPort(Protocol):
+    """What the controller needs from the view changer (it is also the
+    failure detector: a complaint is a vote to change views)."""
+
+    def handle_message(self, sender: int, msg: ConsensusMessage) -> None: ...
+
+    def handle_view_message(self, sender: int, msg: ConsensusMessage) -> None:
+        """Feed 3-phase traffic to the embedded in-flight view (if any)."""
+
+    def start_view_change(self, view: int, stop_view: bool) -> None: ...
+
+    def inform_new_view(self, view: int) -> None: ...
+
+
+class Controller:
+    def __init__(
+        self,
+        *,
+        scheduler: Scheduler,
+        config: Configuration,
+        nodes: Sequence[int],
+        comm: Comm,
+        application: Application,
+        assembler: Assembler,
+        verifier: Verifier,
+        signer: Signer,
+        synchronizer: Synchronizer,
+        pool: RequestPool,
+        batcher: Batcher,
+        leader_monitor: HeartbeatMonitor,
+        collector: StateCollector,
+        state: PersistedState,
+        in_flight: InFlightData,
+        checkpoint: Checkpoint,
+        proposer_builder: ProposalMaker,
+        view_changer: Optional[ViewChangerPort] = None,
+        on_reconfig: Optional[Callable[[Reconfig], None]] = None,
+    ) -> None:
+        self._sched = scheduler
+        self._config = config
+        self.id = config.self_id
+        self.nodes = tuple(nodes)
+        self.n = len(self.nodes)
+        self.quorum, self.f = compute_quorum(self.n)
+        self._comm = comm
+        self._application = application
+        self._assembler = assembler
+        self._verifier = verifier
+        self._signer = signer
+        self._synchronizer = synchronizer
+        self.pool = pool
+        self.batcher = batcher
+        self.leader_monitor = leader_monitor
+        self.collector = collector
+        self._state = state
+        self.in_flight = in_flight
+        self.checkpoint = checkpoint
+        self._proposer_builder = proposer_builder
+        self.view_changer = view_changer
+        self._on_reconfig = on_reconfig
+
+        self.curr_view_number = 0
+        self.curr_decisions_in_view = 0
+        self.curr_view: Optional[View] = None
+        self._verification_sequence = 0
+        self._leader_token = False
+        self._propose_pending = False
+        self._batch_outstanding = False
+        self._sync_in_progress = False
+        self._stopped = True
+
+    # ------------------------------------------------------------ identity
+
+    def leader_id(self) -> int:
+        """Deterministic leader for the current position.
+
+        Parity: reference controller.go:169-183 + util.go:79-107."""
+        blacklist: tuple[int, ...] = ()
+        if self._config.leader_rotation:
+            proposal, _ = self.checkpoint.get()
+            if proposal.metadata:
+                blacklist = tuple(decode_view_metadata(proposal.metadata).black_list)
+        return get_leader_id(
+            self.curr_view_number,
+            self.n,
+            self.nodes,
+            leader_rotation=self._config.leader_rotation,
+            decisions_in_view=self.curr_decisions_in_view,
+            decisions_per_leader=self._config.decisions_per_leader,
+            blacklist=blacklist,
+        )
+
+    def i_am_the_leader(self) -> bool:
+        return self.leader_id() == self.id
+
+    def latest_seq(self) -> int:
+        """Sequence of the last checkpointed decision (0 if none)."""
+        proposal, _ = self.checkpoint.get()
+        if not proposal.metadata:
+            return 0
+        return decode_view_metadata(proposal.metadata).latest_sequence
+
+    def view_sequence(self) -> tuple[bool, int]:
+        """(view_active, in-progress sequence) — for heartbeats and state
+        transfer responses."""
+        v = self.curr_view
+        if v is None or v.stopped:
+            return False, 0
+        return True, v.proposal_sequence
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(
+        self,
+        start_view_number: int,
+        start_proposal_sequence: int,
+        start_decisions_in_view: int,
+        sync_on_start: bool = False,
+    ) -> None:
+        """Parity: reference controller.go:781-811."""
+        self._stopped = False
+        self._verification_sequence = self._verifier.verification_sequence()
+        if sync_on_start:
+            def after(view: int, seq: int, decisions: int) -> None:
+                v, s, d = start_view_number, start_proposal_sequence, start_decisions_in_view
+                if view > v:
+                    v, d = view, decisions
+                if seq > s:
+                    s, d = seq, decisions
+                self.curr_view_number = v
+                self.curr_decisions_in_view = d
+                self._start_view(s)
+
+            self._do_sync(on_complete=after)
+            return
+        self.curr_view_number = start_view_number
+        self.curr_decisions_in_view = start_decisions_in_view
+        self._start_view(start_proposal_sequence)
+
+    def stop(self, *, pool_pause_only: bool = False) -> None:
+        """Parity: reference controller.go:834-871 (Stop/StopWithPoolPause)."""
+        self._stopped = True
+        self._leader_token = False
+        self.batcher.close()
+        if pool_pause_only:
+            self.pool.stop_timers()
+        else:
+            self.pool.close()
+        self.leader_monitor.close()
+        self.collector.close()
+        if self.curr_view is not None:
+            self.curr_view.abort()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def _start_view(self, proposal_sequence: int) -> None:
+        """Parity: reference controller.go:375-396."""
+        view, init_phase = self._proposer_builder.new_proposer(
+            self.leader_id(),
+            proposal_sequence,
+            self.curr_view_number,
+            self.curr_decisions_in_view,
+        )
+        self.curr_view = view
+        view.start()
+        if self.i_am_the_leader():
+            if init_phase in (Phase.COMMITTED, Phase.ABORT):
+                self._acquire_leader_token()
+            self.leader_monitor.change_role(
+                Role.LEADER, self.curr_view_number, self.leader_id()
+            )
+        else:
+            self.leader_monitor.change_role(
+                Role.FOLLOWER, self.curr_view_number, self.leader_id()
+            )
+        logger.info(
+            "%d: started view %d at seq %d (leader %d)",
+            self.id, self.curr_view_number, proposal_sequence, self.leader_id(),
+        )
+
+    def change_view(
+        self, new_view_number: int, new_proposal_sequence: int, new_decisions: int
+    ) -> None:
+        """Parity: reference controller.go:398-426."""
+        if self.curr_view_number > new_view_number:
+            return
+        if (
+            self.curr_view is not None
+            and not self.curr_view.stopped
+            and self.curr_view_number == new_view_number
+            and self.curr_view.leader_id == self.leader_id()
+            and self.curr_decisions_in_view == new_decisions
+        ):
+            return
+        self._abort_view(self.curr_view_number)
+        self.curr_view_number = new_view_number
+        self.curr_decisions_in_view = new_decisions
+        self._start_view(new_proposal_sequence)
+        if self.i_am_the_leader():
+            self.batcher.reset()
+
+    def _abort_view(self, view: int) -> bool:
+        if view < self.curr_view_number:
+            return False
+        self._leader_token = False
+        if self.curr_view is not None:
+            self.curr_view.abort()
+        return True
+
+    # ------------------------------------------------------------- ingress
+
+    def process_message(self, sender: int, msg: ConsensusMessage) -> None:
+        """Top-level message router.
+
+        Parity: reference controller.go:321-373 (ProcessMessages)."""
+        if self._stopped:
+            return
+        if isinstance(msg, (PrePrepare, Prepare, Commit)):
+            if self.curr_view is not None:
+                self.curr_view.handle_message(sender, msg)
+            if self.view_changer is not None:
+                self.view_changer.handle_view_message(sender, msg)
+            if sender == self.leader_id():
+                self.leader_monitor.inject_artificial_heartbeat(
+                    sender, HeartBeat(view=msg.view, seq=msg.seq)
+                )
+        elif isinstance(msg, (ViewChange, SignedViewData, NewView)):
+            if self.view_changer is not None:
+                self.view_changer.handle_message(sender, msg)
+        elif isinstance(msg, (HeartBeat, HeartBeatResponse)):
+            self.leader_monitor.process_msg(sender, msg)
+        elif isinstance(msg, StateTransferRequest):
+            active, seq = self.view_sequence()
+            self._comm.send_consensus(
+                sender,
+                StateTransferResponse(
+                    view_num=self.curr_view_number,
+                    sequence=seq if active else self.latest_seq(),
+                ),
+            )
+        elif isinstance(msg, StateTransferResponse):
+            self.collector.handle_response(sender, msg)
+        else:
+            logger.warning("%d: unknown message %s from %d", self.id, msg, sender)
+
+    # --------------------------------------------------------- requests
+
+    def submit_request(self, raw: bytes, on_done=None) -> None:
+        """Client ingress.  Parity: reference controller.go:249-264."""
+        if self._stopped:
+            if on_done:
+                on_done("not running")
+            return
+        self.pool.submit(raw, on_done)
+
+    def handle_request(self, sender: int, raw: bytes) -> None:
+        """A follower forwarded a request to us (the presumed leader):
+        verify, then pool it.  Parity: reference controller.go:233-246."""
+        if not self.i_am_the_leader():
+            logger.warning("%d: got forwarded request but not leader", self.id)
+            return
+        try:
+            self._verifier.verify_request(raw)
+        except Exception as e:
+            logger.warning("%d: forwarded request failed verification: %s", self.id, e)
+            return
+        self.pool.submit(raw)
+
+    # Pool timeout cascade (RequestTimeoutHandler).
+    def on_request_timeout(self, raw: bytes, info: RequestInfo) -> None:
+        leader = self.leader_id()
+        if leader == self.id:
+            return
+        logger.debug("%d: forwarding %s to leader %d", self.id, info, leader)
+        self._comm.send_transaction(leader, raw)
+
+    def on_leader_fwd_request_timeout(self, raw: bytes, info: RequestInfo) -> None:
+        logger.warning("%d: complaining about leader (request %s)", self.id, info)
+        self.complain(self.curr_view_number, stop_view=False)
+
+    def on_auto_remove_timeout(self, info: RequestInfo) -> None:
+        pass  # pool already dropped it
+
+    # Heartbeat events (HeartbeatEventHandler).
+    def on_heartbeat_timeout(self, view: int, leader_id: int) -> None:
+        if view != self.curr_view_number:
+            return
+        logger.warning("%d: heartbeat timeout on leader %d", self.id, leader_id)
+        self.complain(view, stop_view=False)
+
+    def complain(self, view: int, stop_view: bool) -> None:
+        """FailureDetector seam.  Parity: consensus.go wires the view changer
+        here (pkg/consensus/consensus.go:69-73)."""
+        if self.view_changer is not None:
+            self.view_changer.start_view_change(view, stop_view)
+
+    # ------------------------------------------------------------ proposing
+
+    def _acquire_leader_token(self) -> None:
+        """Parity: reference controller.go:748-755 — but as a scheduled
+        continuation instead of a channel token."""
+        if self._leader_token:
+            return
+        self._leader_token = True
+        if not self._propose_pending:
+            self._propose_pending = True
+            self._sched.post(self._propose, name="leader-propose")
+
+    def _propose(self) -> None:
+        self._propose_pending = False
+        if not self._leader_token or self._stopped or self._batch_outstanding:
+            return
+        self._leader_token = False
+        self._batch_outstanding = True
+        self.batcher.next_batch(self._on_batch)
+
+    def _on_batch(self, batch: list[bytes]) -> None:
+        self._batch_outstanding = False
+        if self._stopped:
+            return
+        if not batch:
+            self._acquire_leader_token()  # try again later
+            return
+        if self.curr_view is None or self.curr_view.stopped:
+            return
+        metadata = self.curr_view.get_metadata()
+        proposal = self._assembler.assemble_proposal(metadata, batch)
+        self.curr_view.propose(proposal)
+
+    # ------------------------------------------------------------- deciding
+
+    def decide(
+        self,
+        proposal: Proposal,
+        signatures: Sequence[Signature],
+        requests: Sequence[RequestInfo],
+    ) -> None:
+        """Called synchronously by the View once a quorum committed.
+
+        Parity: reference controller.go:528-558 (decide) + 873-890 (Decide)
+        + the MutuallyExclusiveDeliver guard (928-965)."""
+        reconfig = self._deliver_checked(proposal, signatures)
+        for info in requests:
+            self.pool.remove_request(info)
+        self.curr_decisions_in_view += 1
+
+        if reconfig.in_latest_decision:
+            logger.info("%d: decision carried a reconfiguration", self.id)
+            if self._on_reconfig is not None:
+                self._on_reconfig(reconfig)
+            return
+
+        md = decode_view_metadata(proposal.metadata)
+        if self._check_if_rotate(md.black_list):
+            logger.info("%d: rotating leader after seq %d", self.id, md.latest_sequence)
+            self.change_view(
+                self.curr_view_number, md.latest_sequence + 1, self.curr_decisions_in_view
+            )
+            self.pool.restart_timers()
+        self.maybe_prune_revoked_requests()
+        if self.i_am_the_leader():
+            self._acquire_leader_token()
+
+    def _deliver_checked(
+        self, proposal: Proposal, signatures: Sequence[Signature]
+    ) -> Reconfig:
+        """Deliver unless this sequence was already obtained via sync.
+
+        Parity: reference controller.go:928-965."""
+        md = decode_view_metadata(proposal.metadata)
+        latest = self.latest_seq()
+        if latest != 0 and latest >= md.latest_sequence:
+            logger.info(
+                "%d: seq %d already synced (latest %d); syncing instead of delivering",
+                self.id, md.latest_sequence, latest,
+            )
+            response = self._synchronizer.sync()
+            if response.latest is not None:
+                self.checkpoint.set(
+                    response.latest.proposal, response.latest.signatures
+                )
+            return response.reconfig
+        reconfig = self._application.deliver(proposal, signatures)
+        self.checkpoint.set(proposal, signatures)
+        return reconfig
+
+    def _check_if_rotate(self, blacklist: Sequence[int]) -> bool:
+        """Parity: reference controller.go:560-574 (called post-increment)."""
+        if not self._config.leader_rotation:
+            return False
+        curr = get_leader_id(
+            self.curr_view_number, self.n, self.nodes,
+            leader_rotation=True,
+            decisions_in_view=self.curr_decisions_in_view - 1,
+            decisions_per_leader=self._config.decisions_per_leader,
+            blacklist=blacklist,
+        )
+        nxt = get_leader_id(
+            self.curr_view_number, self.n, self.nodes,
+            leader_rotation=True,
+            decisions_in_view=self.curr_decisions_in_view,
+            decisions_per_leader=self._config.decisions_per_leader,
+            blacklist=blacklist,
+        )
+        return curr != nxt
+
+    def maybe_prune_revoked_requests(self) -> None:
+        """Parity: reference controller.go:733-746 — on a verification-
+        sequence change, re-validate the whole pool (a sig-heavy burst the
+        TPU verifier absorbs as batches)."""
+        new_vseq = self._verifier.verification_sequence()
+        if new_vseq == self._verification_sequence:
+            return
+        logger.info(
+            "%d: verification sequence %d -> %d; pruning pool",
+            self.id, self._verification_sequence, new_vseq,
+        )
+        self._verification_sequence = new_vseq
+
+        def keep(raw: bytes) -> bool:
+            try:
+                self._verifier.verify_request(raw)
+                return True
+            except Exception:
+                return False
+
+        self.pool.prune(keep)
+
+    # ----------------------------------------------------------------- sync
+
+    def sync(self) -> None:
+        """Schedule a synchronization (idempotent while one is running).
+
+        Parity: reference controller.go:449-454 + syncChan."""
+        if self._sync_in_progress or self._stopped:
+            return
+        if self.i_am_the_leader():
+            self.batcher.close()
+        self._sched.post(lambda: self._do_sync(), name="controller-sync")
+
+    def _do_sync(
+        self, on_complete: Optional[Callable[[int, int, int], None]] = None
+    ) -> None:
+        """Parity: reference controller.go:576-680 (sync)."""
+        if self._sync_in_progress:
+            return
+        self._sync_in_progress = True
+
+        response = self._synchronizer.sync()
+        if response.reconfig.in_latest_decision:
+            self._sync_in_progress = False
+            if self._on_reconfig is not None:
+                self._on_reconfig(response.reconfig)
+            return
+
+        latest = response.latest
+        latest_md: Optional[ViewMetadata] = None
+        if latest is not None and latest.proposal.metadata:
+            latest_md = decode_view_metadata(latest.proposal.metadata)
+
+        controller_seq = self.latest_seq()
+        new_view = self.curr_view_number
+        new_seq = controller_seq + 1
+        new_decisions = 0
+
+        if latest_md is not None and latest_md.latest_sequence > controller_seq:
+            logger.info(
+                "%d: sync advanced us to seq %d (was %d)",
+                self.id, latest_md.latest_sequence, controller_seq,
+            )
+            self.checkpoint.set(latest.proposal, latest.signatures)
+            self._verification_sequence = latest.proposal.verification_sequence
+            new_seq = latest_md.latest_sequence + 1
+            new_decisions = latest_md.decisions_in_view + 1
+        if latest_md is not None and latest_md.view_id > self.curr_view_number:
+            new_view = latest_md.view_id
+
+        def on_state(result: Optional[tuple[int, int]]) -> None:
+            nonlocal new_view, new_decisions
+            self._sync_in_progress = False
+            latest_decision_seq = (
+                latest_md.latest_sequence if latest_md is not None else 0
+            )
+            latest_decision_view = latest_md.view_id if latest_md is not None else 0
+            if result is None:
+                logger.info("%d: state fetch failed", self.id)
+                if latest_md is None or latest_decision_view < self.curr_view_number:
+                    self._finish_sync(0, 0, 0, on_complete)
+                    return
+            else:
+                view, seq = result
+                if (
+                    view <= self.curr_view_number
+                    and latest_decision_view < self.curr_view_number
+                ):
+                    self._finish_sync(0, 0, 0, on_complete)
+                    return
+                if view > new_view and seq == latest_decision_seq + 1:
+                    logger.info(
+                        "%d: cluster is at view %d seq %d", self.id, view, seq
+                    )
+                    self._state.save(
+                        SavedNewView(
+                            view_metadata=ViewMetadata(
+                                view_id=view,
+                                latest_sequence=latest_decision_seq,
+                                decisions_in_view=0,
+                            )
+                        )
+                    )
+                    new_view = view
+                    new_decisions = 0
+            if latest_md is not None:
+                self._maybe_prune_in_flight(latest_md)
+            if new_view > self.curr_view_number and self.view_changer is not None:
+                self.view_changer.inform_new_view(new_view)
+            self._finish_sync(new_view, new_seq, new_decisions, on_complete)
+
+        self.collector.begin(on_state)
+        self.broadcast(StateTransferRequest())
+
+    def _finish_sync(
+        self,
+        view: int,
+        seq: int,
+        decisions: int,
+        on_complete: Optional[Callable[[int, int, int], None]],
+    ) -> None:
+        self.maybe_prune_revoked_requests()
+        if on_complete is not None:
+            # start(sync_on_start=True) path: caller decides what to start.
+            on_complete(view, seq, decisions)
+            return
+        if view > 0 or seq > 0:
+            self.change_view(view, seq, decisions)
+        else:
+            active, vseq = self.view_sequence()
+            self.change_view(
+                self.curr_view_number,
+                vseq if active else self.latest_seq() + 1,
+                self.curr_decisions_in_view,
+            )
+
+    def _maybe_prune_in_flight(self, synced_md: ViewMetadata) -> None:
+        """Parity: reference controller.go:682-705."""
+        proposal = self.in_flight.proposal()
+        if proposal is None:
+            return
+        in_flight_md = decode_view_metadata(proposal.metadata)
+        if synced_md.latest_sequence < in_flight_md.latest_sequence:
+            return
+        logger.info(
+            "%d: synced past in-flight seq %d; clearing it",
+            self.id, in_flight_md.latest_sequence,
+        )
+        self.in_flight.clear()
+
+    # --------------------------------------------------------------- egress
+
+    def broadcast(self, msg: ConsensusMessage) -> None:
+        """Send to all peers (not self); protocol traffic doubles as our
+        heartbeat.  Parity: reference controller.go:912-926."""
+        for node in self.nodes:
+            if node == self.id:
+                continue
+            self._comm.send_consensus(node, msg)
+        if isinstance(msg, (PrePrepare, Prepare, Commit)) and self.i_am_the_leader():
+            self.leader_monitor.heartbeat_was_sent()
+
+    # View-facing comm adapter (View broadcasts through the controller so
+    # heartbeat suppression and self-exclusion apply uniformly).
+    def send(self, target_id: int, msg: ConsensusMessage) -> None:
+        self._comm.send_consensus(target_id, msg)
+
+    # ViewChanged hook (called by the ViewChanger).
+    def view_changed(self, new_view_number: int, new_proposal_sequence: int) -> None:
+        """Parity: reference controller.go:466-473."""
+        if self.i_am_the_leader():
+            self.batcher.close()
+        self.change_view(new_view_number, new_proposal_sequence, 0)
+
+    def abort_view(self, view: int) -> None:
+        """Parity: reference controller.go:457-464."""
+        self.batcher.close()
+        self._abort_view(view)
+
+
+__all__ = ["Controller", "ViewChangerPort"]

@@ -1,0 +1,231 @@
+"""Persisted protocol state: WAL encode/decode and crash-restore-into-phase.
+
+Parity: reference internal/bft/state.go:31-247 (PersistedState), util.go:191-254
+(InFlightData), util.go:257-336 (ProposalMaker).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Sequence
+
+from consensus_tpu.api.deps import WriteAheadLog
+from consensus_tpu.core.view import Phase, View
+from consensus_tpu.types import Proposal, Signature
+from consensus_tpu.wire import (
+    Commit,
+    Prepare,
+    ProposedRecord,
+    SavedCommit,
+    SavedMessage,
+    SavedNewView,
+    SavedViewChange,
+    ViewChange,
+    ViewMetadata,
+    decode_saved,
+    decode_view_metadata,
+    encode_saved,
+)
+
+logger = logging.getLogger("consensus_tpu.state")
+
+
+class InFlightData:
+    """Holder of the proposal currently moving through the 3-phase pipeline,
+    plus whether we got it to the PREPARED stage.
+
+    Parity: reference internal/bft/util.go:191-254 (lock dropped — the
+    runtime is single-threaded per replica).
+    """
+
+    def __init__(self) -> None:
+        self._proposal: Optional[Proposal] = None
+        self._prepared = False
+
+    def proposal(self) -> Optional[Proposal]:
+        return self._proposal
+
+    def is_prepared(self) -> bool:
+        return self._prepared
+
+    def store_proposal(self, proposal: Proposal) -> None:
+        self._proposal = proposal
+        self._prepared = False
+
+    def store_prepared(self, view: int, seq: int) -> None:
+        prop = self._proposal
+        if prop is None:
+            return
+        md = decode_view_metadata(prop.metadata) if prop.metadata else ViewMetadata()
+        if md.view_id == view and md.latest_sequence == seq:
+            self._prepared = True
+
+    def clear(self) -> None:
+        self._proposal = None
+        self._prepared = False
+
+
+class PersistedState:
+    """Bridges protocol records to the WAL and restores a View mid-protocol.
+
+    Parity: reference internal/bft/state.go:31-247.
+    """
+
+    def __init__(
+        self,
+        wal: WriteAheadLog,
+        in_flight: InFlightData,
+        entries: Sequence[bytes] = (),
+    ) -> None:
+        self._wal = wal
+        self._in_flight = in_flight
+        #: Raw WAL entries read at boot (the restore source).
+        self.entries = list(entries)
+
+    # --- saving ------------------------------------------------------------
+
+    def save(self, record: SavedMessage) -> None:
+        """Persist one protocol step.  A new ProposedRecord doubles as a
+        truncation point: the previous proposal is then stably decided
+        (reference state.go:38-59)."""
+        if isinstance(record, ProposedRecord):
+            self._in_flight.store_proposal(record.pre_prepare.proposal)
+        elif isinstance(record, SavedCommit):
+            self._in_flight.store_prepared(record.commit.view, record.commit.seq)
+        self._wal.append(encode_saved(record), truncate_to=isinstance(record, ProposedRecord))
+
+    # --- boot-time peeking (pkg/consensus setViewAndSeq equivalents) -------
+
+    def _last_record(self) -> Optional[SavedMessage]:
+        if not self.entries:
+            return None
+        return decode_saved(self.entries[-1])
+
+    def load_new_view_if_applicable(self) -> Optional[tuple[int, int]]:
+        """(view, seq) if the log ends with a finalized new-view record.
+
+        Parity: reference state.go:80-95."""
+        last = self._last_record()
+        if isinstance(last, SavedNewView):
+            md = last.view_metadata
+            return md.view_id, md.latest_sequence
+        return None
+
+    def load_view_change_if_applicable(self) -> Optional[ViewChange]:
+        """The pending view-change vote if the log ends with one.
+
+        Parity: reference state.go:97-113."""
+        last = self._last_record()
+        if isinstance(last, SavedViewChange):
+            return last.view_change
+        return None
+
+    # --- restore-into-phase (state.go:115-247) -----------------------------
+
+    def restore(self, view: View) -> None:
+        """Re-enter the phase the replica crashed in: PROPOSED if the last
+        record is a proposal, PREPARED if it is our commit (with our own
+        signature resurrected)."""
+        view.phase = Phase.COMMITTED
+        last = self._last_record()
+        if last is None:
+            logger.info("nothing to restore")
+            return
+        if isinstance(last, ProposedRecord):
+            self._recover_proposed(last, view)
+        elif isinstance(last, SavedCommit):
+            self._recover_prepared(last, view)
+        # SavedNewView / SavedViewChange need no phase recovery.
+
+    def _recover_proposed(self, record: ProposedRecord, view: View) -> None:
+        pp = record.pre_prepare
+        self._in_flight.store_proposal(pp.proposal)
+        view.in_flight_proposal = pp.proposal
+        view.number = pp.view
+        view.proposal_sequence = pp.seq
+        md = decode_view_metadata(pp.proposal.metadata)
+        view.decisions_in_view = md.decisions_in_view
+        view.phase = Phase.PROPOSED
+        # The prepare we must re-broadcast on start.
+        p = record.prepare
+        view._curr_prepare_sent = Prepare(
+            view=p.view, seq=p.seq, digest=p.digest, assist=True
+        )
+        logger.info("restored into PROPOSED at seq %d", pp.seq)
+
+    def _recover_prepared(self, record: SavedCommit, view: View) -> None:
+        commit = record.commit
+        if len(self.entries) < 2:
+            raise ValueError("commit record without a preceding pre-prepare")
+        prev = decode_saved(self.entries[-2])
+        if not isinstance(prev, ProposedRecord):
+            raise ValueError(
+                f"expected ProposedRecord before commit, got {type(prev).__name__}"
+            )
+        pp = prev.pre_prepare
+        if view.proposal_sequence < pp.seq:
+            raise ValueError(
+                f"WAL seq {pp.seq} is ahead of our last committed {view.proposal_sequence}"
+            )
+        if view.proposal_sequence > pp.seq:
+            logger.info("seq %d already safely committed", view.proposal_sequence)
+            return
+        self._in_flight.store_proposal(pp.proposal)
+        self._in_flight.store_prepared(commit.view, commit.seq)
+        view.in_flight_proposal = pp.proposal
+        view.number = pp.view
+        view.proposal_sequence = pp.seq
+        md = decode_view_metadata(pp.proposal.metadata)
+        view.decisions_in_view = md.decisions_in_view
+        view.my_commit_signature = commit.signature
+        view.phase = Phase.PREPARED
+        view._curr_commit_sent = Commit(
+            view=commit.view,
+            seq=commit.seq,
+            digest=commit.digest,
+            signature=commit.signature,
+            assist=True,
+        )
+        logger.info("restored into PREPARED at seq %d", pp.seq)
+
+
+class ProposalMaker:
+    """Builds each View, restoring protocol state from the WAL exactly once
+    (the first view created after boot).
+
+    Parity: reference internal/bft/util.go:257-336 (ProposalMaker).
+    """
+
+    def __init__(
+        self,
+        *,
+        state: PersistedState,
+        view_factory: Callable[..., View],
+    ) -> None:
+        self._state = state
+        self._factory = view_factory
+        self._restored_once = False
+
+    def new_proposer(
+        self,
+        leader_id: int,
+        proposal_sequence: int,
+        view_number: int,
+        decisions_in_view: int,
+    ) -> tuple[View, Phase]:
+        view = self._factory(
+            leader_id=leader_id,
+            proposal_sequence=proposal_sequence,
+            number=view_number,
+            decisions_in_view=decisions_in_view,
+        )
+        if not self._restored_once:
+            self._restored_once = True
+            try:
+                self._state.restore(view)
+            except Exception:
+                logger.exception("WAL restore failed; starting clean")
+        return view, view.phase
+
+
+__all__ = ["InFlightData", "PersistedState", "ProposalMaker"]

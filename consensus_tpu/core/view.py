@@ -1,0 +1,710 @@
+"""The View: one instance of the 3-phase ordering pipeline.
+
+Parity: reference internal/bft/view.go (the 1085-LoC hot loop).  A View is
+created per (view number, leader) and restarted on every decision, rotation,
+or view change.  Phases walk COMMITTED → PROPOSED → PREPARED → (decide) →
+COMMITTED, with ABORT as the exit.
+
+Architectural deviations (deliberate, TPU-first):
+
+* **Event-driven, not goroutine-driven.**  The reference's ``run`` loop
+  blocks on channels (view.go:262-299); here ``handle_message`` mutates vote
+  state and ``_advance`` replays the phase logic until it stalls waiting for
+  more input.  Decisions hand off through the scheduler (``post``) so deep
+  decide→next-seq chains never recurse.
+* **Batched commit verification.**  The reference spawns a goroutine per
+  commit vote and verifies signatures one by one (view.go:537-541,820-849).
+  Here incoming commit votes are *buffered unverified*; once enough are
+  pending to possibly reach quorum they are verified in a single
+  ``verify_consenter_sigs_batch`` call — the seam the TPU engine implements
+  as one vmap'd kernel launch.  The same batch seam covers the leader-carried
+  previous-commit signatures in ``verify_proposal``.
+"""
+
+from __future__ import annotations
+
+import logging
+from enum import IntEnum
+from typing import Callable, Optional, Protocol, Sequence
+
+from consensus_tpu.api.deps import MembershipNotifier, Signer, Verifier
+from consensus_tpu.runtime.scheduler import Scheduler
+from consensus_tpu.types import Proposal, RequestInfo, Signature
+from consensus_tpu.utils.digests import commit_signatures_digest
+from consensus_tpu.utils.blacklist import compute_blacklist_update
+from consensus_tpu.utils.quorum import compute_quorum
+from consensus_tpu.wire import (
+    Commit,
+    ConsensusMessage,
+    PrePrepare,
+    Prepare,
+    PreparesFrom,
+    ProposedRecord,
+    SavedCommit,
+    ViewMetadata,
+    decode_prepares_from,
+    decode_view_metadata,
+    encode_prepares_from,
+    encode_view_metadata,
+    msg_to_string,
+)
+
+logger = logging.getLogger("consensus_tpu.view")
+
+
+class Phase(IntEnum):
+    """Parity: reference internal/bft/view.go:23-46."""
+
+    COMMITTED = 0
+    PROPOSED = 1
+    PREPARED = 2
+    ABORT = 3
+
+
+class Decider(Protocol):
+    """Receives a decided proposal (the Controller).
+
+    Parity: reference internal/bft/controller.go:22-24.
+    """
+
+    def decide(
+        self,
+        proposal: Proposal,
+        signatures: Sequence[Signature],
+        requests: Sequence[RequestInfo],
+    ) -> None: ...
+
+
+class FailureDetector(Protocol):
+    """Parity: reference internal/bft/controller.go:29-31."""
+
+    def complain(self, view: int, stop_view: bool) -> None: ...
+
+
+class SyncRequester(Protocol):
+    def sync(self) -> None: ...
+
+
+class ViewComm(Protocol):
+    """Outbound messaging as the view sees it (Controller provides it)."""
+
+    def broadcast(self, msg: ConsensusMessage) -> None: ...
+
+    def send(self, target_id: int, msg: ConsensusMessage) -> None: ...
+
+
+class ViewState(Protocol):
+    """WAL persistence seam (PersistedState implements it)."""
+
+    def save(self, record) -> None: ...
+
+
+class CheckpointReader(Protocol):
+    def get(self) -> tuple[Proposal, tuple[Signature, ...]]: ...
+
+
+class View:
+    """A single view's ordering state machine."""
+
+    def __init__(
+        self,
+        *,
+        scheduler: Scheduler,
+        self_id: int,
+        number: int,
+        leader_id: int,
+        proposal_sequence: int,
+        decisions_in_view: int,
+        n: int,
+        nodes: Sequence[int],
+        comm: ViewComm,
+        verifier: Verifier,
+        signer: Signer,
+        state: ViewState,
+        decider: Decider,
+        failure_detector: FailureDetector,
+        sync_requester: SyncRequester,
+        checkpoint: CheckpointReader,
+        decisions_per_leader: int = 0,
+        membership_notifier: Optional[MembershipNotifier] = None,
+        blacklist_supported: bool = False,
+    ) -> None:
+        self._sched = scheduler
+        self.self_id = self_id
+        self.number = number
+        self.leader_id = leader_id
+        self.proposal_sequence = proposal_sequence
+        self.decisions_in_view = decisions_in_view
+        self.n = n
+        self.nodes = tuple(nodes)
+        self.quorum, self.f = compute_quorum(n)
+        self._comm = comm
+        self._verifier = verifier
+        self._signer = signer
+        self._state = state
+        self._decider = decider
+        self._failure_detector = failure_detector
+        self._sync = sync_requester
+        self._checkpoint = checkpoint
+        self.decisions_per_leader = decisions_per_leader
+        self._membership_notifier = membership_notifier
+        self._blacklist_supported = blacklist_supported
+
+        self.phase = Phase.COMMITTED
+        self.in_flight_proposal: Optional[Proposal] = None
+        self.in_flight_requests: Sequence[RequestInfo] = ()
+        self.my_commit_signature: Optional[Signature] = None
+
+        # Pipelining buffers: current sequence + the next one (depth 1),
+        # parity: reference view.go:107-113,860-894.
+        self._pending_pre_prepare: Optional[tuple[int, PrePrepare]] = None
+        self._next_pre_prepare: Optional[tuple[int, PrePrepare]] = None
+        self._prepares: dict[int, Prepare] = {}
+        self._next_prepares: dict[int, Prepare] = {}
+        self._commits: dict[int, Commit] = {}
+        self._next_commits: dict[int, Commit] = {}
+        #: Commit signatures proven valid for the in-flight proposal.
+        self._valid_commit_sigs: dict[int, Signature] = {}
+        #: Commit senders whose signature failed batch verification.
+        self._rejected_commit_senders: set[int] = set()
+
+        # Retransmission help (previous sequence), view.go:718-756.
+        self._prev_prepare_sent: Optional[Prepare] = None
+        self._prev_commit_sent: Optional[Commit] = None
+        self._curr_prepare_sent: Optional[Prepare] = None
+        self._curr_commit_sent: Optional[Commit] = None
+
+        # Censorship / partition detection, view.go:758-818.
+        self._last_voted_proposal_by_id: dict[int, Commit] = {}
+
+        self.stopped = False
+
+    # ------------------------------------------------------------------ API
+
+    def start(self) -> None:
+        """Kick a (possibly WAL-restored) view into action: re-broadcast the
+        message implied by the restored phase (reference resurrects
+        ``lastBroadcastSent``, internal/bft/state.go:163-247)."""
+        if self.phase == Phase.PROPOSED and self._curr_prepare_sent is not None:
+            self._comm.broadcast(self._curr_prepare_sent)
+        elif self.phase == Phase.PREPARED and self._curr_commit_sent is not None:
+            self._comm.broadcast(self._curr_commit_sent)
+
+    def propose(self, proposal: Proposal) -> None:
+        """Leader entry point: wrap ``proposal`` in a PrePrepare carrying the
+        previous decision's commit signatures, and pre-prepare *ourselves*
+        first (the broadcast to others happens after we persist — parity:
+        reference view.go:951-974, 421-423)."""
+        _, prev_sigs = self._checkpoint.get()
+        pp = PrePrepare(
+            view=self.number,
+            seq=self.proposal_sequence,
+            proposal=proposal,
+            prev_commit_signatures=tuple(prev_sigs),
+        )
+        self.handle_message(self.leader_id, pp)
+
+    def abort(self) -> None:
+        """Parity: reference view.go Abort/stop."""
+        self.stopped = True
+        self.phase = Phase.ABORT
+
+    @property
+    def view_sequence(self) -> tuple[int, int]:
+        return self.number, self.proposal_sequence
+
+    # ----------------------------------------------------------- ingress
+
+    def handle_message(self, sender: int, msg: ConsensusMessage) -> None:
+        """Route one consensus message into the view.
+
+        Parity: reference view.go:194-259 (processMsg).
+        """
+        if self.stopped:
+            return
+        if not isinstance(msg, (PrePrepare, Prepare, Commit)):
+            return
+
+        msg_view = msg.view
+        msg_seq = msg.seq
+
+        if msg_view != self.number:
+            if sender != self.leader_id:
+                self._discover_if_sync_needed(sender, msg)
+                return
+            # Wrong view *from the leader* is evidence of a sick leader.
+            logger.warning(
+                "%d: leader %d sent view %d, expected %d — complaining",
+                self.self_id, sender, msg_view, self.number,
+            )
+            self._failure_detector.complain(self.number, False)
+            if msg_view > self.number:
+                self._sync.sync()
+            self.abort()
+            return
+
+        if msg_seq == self.proposal_sequence - 1 and self.proposal_sequence > 0:
+            self._handle_prev_seq_message(sender, msg)
+            return
+
+        if msg_seq not in (self.proposal_sequence, self.proposal_sequence + 1):
+            logger.warning(
+                "%d: got %s from %d at seq %d, ours is %d",
+                self.self_id, msg_to_string(msg), sender, msg_seq, self.proposal_sequence,
+            )
+            self._discover_if_sync_needed(sender, msg)
+            return
+
+        for_next = msg_seq == self.proposal_sequence + 1
+
+        if isinstance(msg, PrePrepare):
+            self._accept_pre_prepare(sender, msg, for_next)
+        elif sender == self.self_id:
+            return  # own votes are implicit
+        elif isinstance(msg, Prepare):
+            votes = self._next_prepares if for_next else self._prepares
+            votes.setdefault(sender, msg)
+            if not for_next:
+                self._advance()
+        else:  # Commit
+            if msg.signature.id != sender:
+                return  # vote must be signed by its sender
+            votes = self._next_commits if for_next else self._commits
+            votes.setdefault(sender, msg)
+            if not for_next:
+                self._advance()
+
+    def _accept_pre_prepare(self, sender: int, pp: PrePrepare, for_next: bool) -> None:
+        if sender != self.leader_id:
+            logger.warning(
+                "%d: pre-prepare from %d but leader is %d",
+                self.self_id, sender, self.leader_id,
+            )
+            return
+        if for_next:
+            if self._next_pre_prepare is None:
+                self._next_pre_prepare = (sender, pp)
+            return
+        if self._pending_pre_prepare is None:
+            self._pending_pre_prepare = (sender, pp)
+            self._advance()
+
+    # ------------------------------------------------------ phase machine
+
+    def _advance(self) -> None:
+        """Re-run the phase logic until it stalls waiting for input.
+
+        Parity: reference view.go:282-299 (doPhase), minus the blocking.
+        """
+        if self.stopped:
+            return
+        if self.phase == Phase.COMMITTED:
+            self._try_process_proposal()
+        if self.phase == Phase.PROPOSED:
+            self._try_process_prepares()
+        if self.phase == Phase.PREPARED:
+            self._try_process_commits()
+
+    # --- COMMITTED -> PROPOSED (view.go:351-427) ---------------------------
+
+    def _try_process_proposal(self) -> None:
+        if self._pending_pre_prepare is None:
+            return
+        _, pp = self._pending_pre_prepare
+        self._pending_pre_prepare = None
+        proposal = pp.proposal
+
+        try:
+            requests = self._verify_proposal(proposal, pp.prev_commit_signatures)
+        except Exception as err:
+            logger.warning(
+                "%d: bad proposal from leader %d: %s", self.self_id, self.leader_id, err
+            )
+            self._failure_detector.complain(self.number, False)
+            self._sync.sync()
+            self.abort()
+            return
+
+        prepare = Prepare(
+            view=self.number, seq=self.proposal_sequence, digest=proposal.digest()
+        )
+        # WAL before send: we must remember having prepared before anyone
+        # hears about it (view.go:404-414).
+        self._state.save(ProposedRecord(pre_prepare=pp, prepare=prepare))
+
+        self.in_flight_proposal = proposal
+        self.in_flight_requests = tuple(requests)
+        self._curr_prepare_sent = Prepare(
+            view=prepare.view, seq=prepare.seq, digest=prepare.digest, assist=True
+        )
+        self.phase = Phase.PROPOSED
+
+        if self.self_id == self.leader_id:
+            # Only now does the leader reveal the proposal to the others.
+            self._comm.broadcast(pp)
+        self._comm.broadcast(prepare)
+        logger.info("%d: proposed seq %d in view %d", self.self_id, prepare.seq, self.number)
+
+    # --- PROPOSED -> PREPARED (view.go:441-517) ----------------------------
+
+    def _try_process_prepares(self) -> None:
+        assert self.in_flight_proposal is not None
+        expected = self.in_flight_proposal.digest()
+        voters = [s for s, p in self._prepares.items() if p.digest == expected]
+        if len(voters) < self.quorum - 1:
+            return
+
+        aux = encode_prepares_from(PreparesFrom(ids=tuple(sorted(voters))))
+        self.my_commit_signature = self._signer.sign_proposal(
+            self.in_flight_proposal, aux
+        )
+        commit = Commit(
+            view=self.number,
+            seq=self.proposal_sequence,
+            digest=expected,
+            signature=self.my_commit_signature,
+        )
+        # WAL before send again: the commit we are about to utter.
+        self._state.save(SavedCommit(commit=commit))
+        self._curr_commit_sent = Commit(
+            view=commit.view,
+            seq=commit.seq,
+            digest=commit.digest,
+            signature=commit.signature,
+            assist=True,
+        )
+        self.phase = Phase.PREPARED
+        self._comm.broadcast(commit)
+        logger.info("%d: prepared seq %d (%d prepares)", self.self_id, commit.seq, len(voters))
+
+    # --- PREPARED -> decide (view.go:519-551, batched) ---------------------
+
+    def _try_process_commits(self) -> None:
+        assert self.in_flight_proposal is not None
+        needed = self.quorum - 1
+        if len(self._valid_commit_sigs) < needed:
+            self._batch_verify_pending_commits(needed)
+        if len(self._valid_commit_sigs) < needed:
+            return
+
+        signatures = list(self._valid_commit_sigs.values())[:needed]
+        proposal = self.in_flight_proposal
+        requests = self.in_flight_requests
+        assert self.my_commit_signature is not None
+        signatures.append(self.my_commit_signature)
+        logger.info(
+            "%d: collected %d commits for seq %d",
+            self.self_id, len(signatures), self.proposal_sequence,
+        )
+        self._start_next_seq()
+        self._decider.decide(proposal, signatures, requests)
+
+    def _batch_verify_pending_commits(self, needed: int) -> None:
+        """Verify buffered commit votes in one batch call (the TPU seam).
+
+        Waits until enough unverified votes are pending to possibly reach
+        quorum, then verifies them all at once — one kernel launch per
+        decision in the common case, versus the reference's
+        goroutine-per-vote (view.go:537-541)."""
+        assert self.in_flight_proposal is not None
+        expected = self.in_flight_proposal.digest()
+        pending: list[Commit] = []
+        for sender, commit in self._commits.items():
+            if sender in self._valid_commit_sigs or sender in self._rejected_commit_senders:
+                continue
+            if commit.digest != expected:
+                continue
+            pending.append(commit)
+        if len(self._valid_commit_sigs) + len(pending) < needed:
+            return  # not enough to possibly decide; keep buffering
+
+        sigs = [c.signature for c in pending]
+        results = self._verifier.verify_consenter_sigs_batch(
+            sigs, self.in_flight_proposal
+        )
+        for commit, result in zip(pending, results):
+            if result is None:
+                logger.warning(
+                    "%d: invalid commit signature from %d",
+                    self.self_id, commit.signature.id,
+                )
+                self._rejected_commit_senders.add(commit.signature.id)
+            else:
+                self._valid_commit_sigs[commit.signature.id] = commit.signature
+
+    # --- sequence pipelining (view.go:851-894) -----------------------------
+
+    def _start_next_seq(self) -> None:
+        self.proposal_sequence += 1
+        self.decisions_in_view += 1
+        self.phase = Phase.COMMITTED
+        self.in_flight_proposal = None
+        self.in_flight_requests = ()
+        self.my_commit_signature = None
+
+        self._prev_prepare_sent = self._curr_prepare_sent
+        self._prev_commit_sent = self._curr_commit_sent
+        self._curr_prepare_sent = None
+        self._curr_commit_sent = None
+
+        self._pending_pre_prepare = self._next_pre_prepare
+        self._next_pre_prepare = None
+        self._prepares = self._next_prepares
+        self._next_prepares = {}
+        self._commits = self._next_commits
+        self._next_commits = {}
+        self._valid_commit_sigs = {}
+        self._rejected_commit_senders = set()
+
+        # Continue with any buffered next-sequence traffic on a fresh stack.
+        if self._pending_pre_prepare is not None or self._prepares or self._commits:
+            self._sched.post(self._advance, name=f"view-{self.number}-advance")
+
+    # --- verification (view.go:553-716) ------------------------------------
+
+    def _verify_proposal(
+        self, proposal: Proposal, prev_commits: Sequence[Signature]
+    ) -> Sequence[RequestInfo]:
+        requests = self._verifier.verify_proposal(proposal)
+
+        md = decode_view_metadata(proposal.metadata)
+        if md.view_id != self.number:
+            raise ValueError(f"metadata view {md.view_id} != {self.number}")
+        if md.latest_sequence != self.proposal_sequence:
+            raise ValueError(
+                f"metadata seq {md.latest_sequence} != {self.proposal_sequence}"
+            )
+        if md.decisions_in_view != self.decisions_in_view:
+            raise ValueError(
+                f"metadata decisions-in-view {md.decisions_in_view} != {self.decisions_in_view}"
+            )
+        expected_vseq = self._verifier.verification_sequence()
+        if proposal.verification_sequence != expected_vseq:
+            raise ValueError(
+                f"verification sequence {proposal.verification_sequence} != {expected_vseq}"
+            )
+
+        prepare_acks = self._verify_prev_commit_signatures(prev_commits, expected_vseq)
+        self._verify_blacklist(prev_commits, expected_vseq, md, prepare_acks)
+
+        # The metadata must commit to the exact previous-signature set.
+        if self.decisions_per_leader > 0:
+            if commit_signatures_digest(prev_commits) != md.prev_commit_signature_digest:
+                raise ValueError("prev commit signatures mismatch metadata digest")
+        return requests
+
+    def _verify_prev_commit_signatures(
+        self, prev_commits: Sequence[Signature], curr_vseq: int
+    ) -> dict[int, PreparesFrom]:
+        """Verify the leader-carried previous-decision signatures *as a
+        batch* and decode each one's prepare-acknowledgement vouch list.
+
+        Parity: reference view.go:606-647 (sequential loop there)."""
+        prev_proposal, _ = self._checkpoint.get()
+        if prev_proposal.verification_sequence != curr_vseq:
+            # Reconfiguration happened in between: signatures were made under
+            # another config — skip (the reference does the same).
+            return {}
+        if not prev_commits:
+            return {}
+        results = self._verifier.verify_consenter_sigs_batch(
+            prev_commits, prev_proposal
+        )
+        acks: dict[int, PreparesFrom] = {}
+        for sig, aux in zip(prev_commits, results):
+            if aux is None:
+                raise ValueError(f"invalid prev commit signature from {sig.id}")
+            try:
+                acks[sig.id] = decode_prepares_from(aux) if aux else PreparesFrom()
+            except Exception as e:
+                raise ValueError(f"bad prepare-ack payload from {sig.id}: {e}") from e
+        return acks
+
+    def _verify_blacklist(
+        self,
+        prev_commits: Sequence[Signature],
+        curr_vseq: int,
+        md: ViewMetadata,
+        prepare_acks: dict[int, PreparesFrom],
+    ) -> None:
+        """Follower-side re-derivation of the leader's blacklist update.
+
+        Parity: reference view.go:649-716."""
+        if self.decisions_per_leader == 0:
+            if md.black_list:
+                raise ValueError(
+                    f"rotation inactive but blacklist is {list(md.black_list)}"
+                )
+            return
+
+        prev_proposal, my_last_sigs = self._checkpoint.get()
+        prev_md = self._decode_prev_metadata(prev_proposal)
+
+        if prev_proposal.verification_sequence != curr_vseq:
+            if tuple(prev_md.black_list) != tuple(md.black_list):
+                raise ValueError("blacklist changed during reconfiguration")
+            return
+        if self._membership_notifier is not None and self._membership_notifier.membership_change():
+            if tuple(prev_md.black_list) != tuple(md.black_list):
+                raise ValueError("blacklist changed during membership change")
+            return
+
+        if self._blacklisting_supported(my_last_sigs) and len(prev_commits) < len(
+            my_last_sigs
+        ):
+            raise ValueError(
+                f"only {len(prev_commits)} of {len(my_last_sigs)} previous commits included"
+            )
+
+        expected = compute_blacklist_update(
+            prev_view=prev_md.view_id,
+            prev_seq=prev_md.latest_sequence,
+            prev_decisions_in_view=prev_md.decisions_in_view,
+            prev_blacklist=list(prev_md.black_list),
+            current_view=self.number,
+            current_leader=self.leader_id,
+            n=self.n,
+            f=self.f,
+            nodes=self.nodes,
+            leader_rotation=self.decisions_per_leader > 0,
+            decisions_per_leader=self.decisions_per_leader,
+            prepares_from={i: list(pf.ids) for i, pf in prepare_acks.items()},
+        )
+        if tuple(md.black_list) != tuple(expected):
+            raise ValueError(
+                f"proposed blacklist {list(md.black_list)} != expected {expected}"
+            )
+
+    def _decode_prev_metadata(self, prev_proposal: Proposal) -> ViewMetadata:
+        if not prev_proposal.metadata:
+            return ViewMetadata()
+        return decode_view_metadata(prev_proposal.metadata)
+
+    def _blacklisting_supported(self, my_last_sigs: Sequence[Signature]) -> bool:
+        """f+1 of the previous commit signatures carrying auxiliary data is
+        the rolling-upgrade witness that blacklisting is active.
+
+        Parity: reference view.go:1061-1085."""
+        if self._blacklist_supported:
+            return True
+        count = sum(
+            1 for sig in my_last_sigs if self._verifier.auxiliary_data(sig.msg)
+        )
+        if count > self.f:
+            self._blacklist_supported = True
+        return self._blacklist_supported
+
+    # --- leader metadata (view.go:896-989) ---------------------------------
+
+    def get_metadata(self) -> bytes:
+        """The ViewMetadata the leader stamps into its next proposal: current
+        position, updated blacklist, and the binding digest over the previous
+        commit signatures."""
+        prev_proposal, prev_sigs = self._checkpoint.get()
+        prev_md = self._decode_prev_metadata(prev_proposal)
+        black_list = tuple(prev_md.black_list)
+
+        vseq = self._verifier.verification_sequence()
+        membership_change = (
+            self._membership_notifier is not None
+            and self._membership_notifier.membership_change()
+        )
+        if (
+            prev_proposal.verification_sequence == vseq
+            and not membership_change
+            and self.decisions_per_leader > 0
+        ):
+            acks: dict[int, list[int]] = {}
+            for sig in prev_sigs:
+                aux = self._verifier.auxiliary_data(sig.msg)
+                if aux:
+                    try:
+                        acks[sig.id] = list(decode_prepares_from(aux).ids)
+                    except Exception:
+                        logger.warning("undecodable prepare-acks from %d", sig.id)
+            black_list = tuple(
+                compute_blacklist_update(
+                    prev_view=prev_md.view_id,
+                    prev_seq=prev_md.latest_sequence,
+                    prev_decisions_in_view=prev_md.decisions_in_view,
+                    prev_blacklist=list(prev_md.black_list),
+                    current_view=self.number,
+                    current_leader=self.leader_id,
+                    n=self.n,
+                    f=self.f,
+                    nodes=self.nodes,
+                    leader_rotation=True,
+                    decisions_per_leader=self.decisions_per_leader,
+                    prepares_from=acks,
+                )
+            )
+
+        prev_digest = (
+            commit_signatures_digest(prev_sigs)
+            if self.decisions_per_leader > 0
+            else b""
+        )
+        md = ViewMetadata(
+            view_id=self.number,
+            latest_sequence=self.proposal_sequence,
+            decisions_in_view=self.decisions_in_view,
+            black_list=black_list,
+            prev_commit_signature_digest=prev_digest,
+        )
+        return encode_view_metadata(md)
+
+    # --- stragglers + censorship (view.go:718-818) --------------------------
+
+    def _handle_prev_seq_message(self, sender: int, msg: ConsensusMessage) -> None:
+        if isinstance(msg, PrePrepare):
+            return
+        if isinstance(msg, Prepare):
+            if msg.assist:
+                return
+            if self._prev_prepare_sent is not None:
+                self._comm.send(sender, self._prev_prepare_sent)
+        elif isinstance(msg, Commit):
+            if msg.assist:
+                return
+            if self._prev_commit_sent is not None:
+                self._comm.send(sender, self._prev_commit_sent)
+
+    def _discover_if_sync_needed(self, sender: int, msg: ConsensusMessage) -> None:
+        """f+1 distinct nodes voting to commit a (view, seq) ahead of ours
+        means we missed a proposal — trigger a sync."""
+        if not isinstance(msg, Commit):
+            return
+        self._last_voted_proposal_by_id[sender] = msg
+        threshold = self.f + 1
+        if len(self._last_voted_proposal_by_id) < threshold:
+            return
+        counts: dict[tuple[str, int, int], int] = {}
+        for vote in self._last_voted_proposal_by_id.values():
+            key = (vote.digest, vote.view, vote.seq)
+            counts[key] = counts.get(key, 0) + 1
+        for (digest, view, seq), count in counts.items():
+            if count < threshold:
+                continue
+            if view < self.number:
+                continue
+            if seq <= self.proposal_sequence and view == self.number:
+                continue
+            logger.warning(
+                "%d: %d votes for (view=%d, seq=%d) vs our (view=%d, seq=%d) — syncing",
+                self.self_id, count, view, seq, self.number, self.proposal_sequence,
+            )
+            self.abort()
+            self._sync.sync()
+            return
+
+
+__all__ = [
+    "View",
+    "Phase",
+    "Decider",
+    "FailureDetector",
+    "SyncRequester",
+    "ViewComm",
+    "ViewState",
+    "CheckpointReader",
+]

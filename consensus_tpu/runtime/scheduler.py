@@ -131,23 +131,42 @@ class SimScheduler:
             # components are expected to catch their own errors.
             logger.exception("unhandled error in event %r", h.name)
 
+    def _drain(
+        self,
+        *,
+        deadline: Optional[float],
+        stop: Optional[Callable[[], bool]],
+        max_events: int,
+        label: str,
+    ) -> int:
+        """Shared event-loop body: pop due events in order, skip cancelled
+        ones, fire the rest; stop at ``deadline`` (virtual time), when
+        ``stop()`` turns true, or after ``max_events`` (livelock guard)."""
+        executed = 0
+        while self._heap:
+            if deadline is not None and self._heap[0].when > deadline:
+                break
+            h = heapq.heappop(self._heap)
+            if h.cancelled:
+                continue
+            if executed >= max_events:
+                raise RuntimeError(f"{label} exceeded {max_events} events")
+            self._now = max(self._now, h.when)
+            self._fire(h)
+            executed += 1
+            if stop is not None and stop():
+                break
+        return executed
+
     def run_until_idle(self, *, max_events: int = 1_000_000) -> int:
         """Run events (advancing virtual time as needed) until none remain.
 
         Returns the number of events executed.  ``max_events`` guards against
         livelock from self-rescheduling handlers.
         """
-        executed = 0
-        while self._heap:
-            h = heapq.heappop(self._heap)
-            if h.cancelled:
-                continue
-            if executed >= max_events:
-                raise RuntimeError(f"run_until_idle exceeded {max_events} events")
-            self._now = max(self._now, h.when)
-            self._fire(h)
-            executed += 1
-        return executed
+        return self._drain(
+            deadline=None, stop=None, max_events=max_events, label="run_until_idle"
+        )
 
     def advance(self, dt: float, *, max_events: int = 1_000_000) -> int:
         """Run all events due within the next ``dt`` seconds, then set the
@@ -155,16 +174,9 @@ class SimScheduler:
         if dt < 0:
             raise ValueError(f"negative dt {dt}")
         deadline = self._now + dt
-        executed = 0
-        while self._heap and self._heap[0].when <= deadline:
-            h = heapq.heappop(self._heap)
-            if h.cancelled:
-                continue
-            if executed >= max_events:
-                raise RuntimeError(f"advance exceeded {max_events} events")
-            self._now = max(self._now, h.when)
-            self._fire(h)
-            executed += 1
+        executed = self._drain(
+            deadline=deadline, stop=None, max_events=max_events, label="advance"
+        )
         self._now = deadline
         return executed
 
@@ -177,21 +189,14 @@ class SimScheduler:
     ) -> bool:
         """Run events until ``predicate()`` holds or the virtual-time budget
         is exhausted.  Returns whether the predicate was met."""
-        deadline = self._now + max_time
-        executed = 0
         if predicate():
             return True
-        while self._heap and self._heap[0].when <= deadline:
-            h = heapq.heappop(self._heap)
-            if h.cancelled:
-                continue
-            if executed >= max_events:
-                raise RuntimeError(f"run_until exceeded {max_events} events")
-            self._now = max(self._now, h.when)
-            self._fire(h)
-            executed += 1
-            if predicate():
-                return True
+        self._drain(
+            deadline=self._now + max_time,
+            stop=predicate,
+            max_events=max_events,
+            label="run_until",
+        )
         return predicate()
 
     @property

@@ -1,0 +1,29 @@
+"""In-process simulated network + all-ports test application.
+
+Parity: reference test/ (network.go, test_app.go).
+"""
+
+from consensus_tpu.testing.app import (
+    ByteInspector,
+    Cluster,
+    MemWAL,
+    Node,
+    TestApp,
+    make_request,
+    pack_batch,
+    unpack_batch,
+)
+from consensus_tpu.testing.network import NodeComm, SimNetwork
+
+__all__ = [
+    "Cluster",
+    "Node",
+    "TestApp",
+    "ByteInspector",
+    "MemWAL",
+    "make_request",
+    "pack_batch",
+    "unpack_batch",
+    "SimNetwork",
+    "NodeComm",
+]

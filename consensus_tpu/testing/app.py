@@ -1,0 +1,316 @@
+"""All-ports test application + cluster builder.
+
+Parity: reference test/test_app.go:49-494 — trivial crypto, a per-node
+in-memory ledger that ``sync`` replays from peers, real (or in-memory) WALs,
+and ``restart`` realism: tearing a replica down and rebuilding the whole
+Consensus over the same WAL content.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional, Sequence
+
+from consensus_tpu.api.deps import (
+    Application,
+    Assembler,
+    RequestInspector,
+    Signer,
+    Synchronizer,
+    Verifier,
+    WriteAheadLog,
+)
+from consensus_tpu.config import Configuration
+from consensus_tpu.consensus import Consensus
+from consensus_tpu.core.view import Phase  # noqa: F401  (re-export convenience)
+from consensus_tpu.runtime.scheduler import SimScheduler
+from consensus_tpu.testing.network import NodeComm, SimNetwork
+from consensus_tpu.types import (
+    Decision,
+    Proposal,
+    Reconfig,
+    RequestInfo,
+    Signature,
+    SyncResponse,
+)
+
+# --- request / batch encoding --------------------------------------------
+# A request is b"client:reqid|payload".  A proposal payload is a packed
+# sequence of requests.
+
+
+def make_request(client: str, rid, payload: bytes = b"") -> bytes:
+    return f"{client}:{rid}|".encode() + payload
+
+
+def pack_batch(requests: Sequence[bytes]) -> bytes:
+    out = [struct.pack(">I", len(requests))]
+    for r in requests:
+        out.append(struct.pack(">I", len(r)))
+        out.append(r)
+    return b"".join(out)
+
+
+def unpack_batch(payload: bytes) -> list[bytes]:
+    (count,) = struct.unpack_from(">I", payload, 0)
+    off = 4
+    out = []
+    for _ in range(count):
+        (n,) = struct.unpack_from(">I", payload, off)
+        off += 4
+        out.append(payload[off : off + n])
+        off += n
+    return out
+
+
+class ByteInspector(RequestInspector):
+    def request_id(self, raw_request: bytes) -> RequestInfo:
+        head = raw_request.split(b"|", 1)[0].decode()
+        client, _, rid = head.partition(":")
+        if not client or not rid:
+            raise ValueError(f"malformed request {raw_request!r}")
+        return RequestInfo(client_id=client, request_id=rid)
+
+
+class MemWAL(WriteAheadLog):
+    """In-memory WAL whose entries survive a simulated crash (the backing
+    list lives in the cluster, not the node object)."""
+
+    def __init__(self, backing: list[bytes]) -> None:
+        self._backing = backing
+
+    def append(self, entry: bytes, truncate_to: bool = False) -> None:
+        if truncate_to:
+            self._backing.clear()
+        self._backing.append(entry)
+
+    @property
+    def entries(self) -> list[bytes]:
+        return list(self._backing)
+
+
+class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
+    """Implements every application-side port with trivial crypto.
+
+    Parity: reference test/test_app.go (SignProposal returns {ID, aux};
+    VerifyConsenterSig echoes the aux back — node.go:90-110 does the same in
+    naive_chain)."""
+
+    def __init__(self, node_id: int, cluster: "Cluster") -> None:
+        self.node_id = node_id
+        self.cluster = cluster
+        self.ledger: list[Decision] = []
+        self.inspector = ByteInspector()
+        self._vseq = 0
+
+    # Application
+    def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
+        decision = Decision(proposal=proposal, signatures=tuple(signatures))
+        self.ledger.append(decision)
+        return self.cluster.reconfig_of(proposal)
+
+    # Assembler
+    def assemble_proposal(self, metadata: bytes, requests: Sequence[bytes]) -> Proposal:
+        return Proposal(
+            payload=pack_batch(requests),
+            header=struct.pack(">Q", len(self.ledger)),
+            metadata=metadata,
+            verification_sequence=self._vseq,
+        )
+
+    # Signer
+    def sign(self, data: bytes) -> bytes:
+        return b"sig-%d" % self.node_id
+
+    def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature:
+        return Signature(id=self.node_id, value=b"sig-%d" % self.node_id, msg=aux)
+
+    # Verifier
+    def verify_proposal(self, proposal: Proposal) -> Sequence[RequestInfo]:
+        return [self.inspector.request_id(r) for r in unpack_batch(proposal.payload)]
+
+    def verify_request(self, raw_request: bytes) -> RequestInfo:
+        return self.inspector.request_id(raw_request)
+
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        if signature.value != b"sig-%d" % signature.id:
+            raise ValueError(f"bad signature from {signature.id}")
+        return signature.msg
+
+    def verify_signature(self, signature: Signature) -> None:
+        if signature.value != b"sig-%d" % signature.id:
+            raise ValueError(f"bad signature from {signature.id}")
+
+    def verification_sequence(self) -> int:
+        return self._vseq
+
+    def requests_from_proposal(self, proposal: Proposal) -> Sequence[RequestInfo]:
+        return [self.inspector.request_id(r) for r in unpack_batch(proposal.payload)]
+
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        return msg
+
+    # Synchronizer: replay missing decisions from the most advanced peer.
+    # Parity: reference test/test_app.go:327-371.
+    def sync(self) -> SyncResponse:
+        best = self.cluster.longest_ledger(exclude=self.node_id)
+        mine = len(self.ledger)
+        reconfig = Reconfig()
+        for decision in best[mine:]:
+            self.ledger.append(decision)
+            r = self.cluster.reconfig_of(decision.proposal)
+            if r.in_latest_decision:
+                reconfig = r
+        if not self.ledger:
+            return SyncResponse(latest=None, reconfig=reconfig)
+        return SyncResponse(latest=self.ledger[-1], reconfig=reconfig)
+
+
+class Node:
+    """A replica: app + consensus + WAL, restartable."""
+
+    def __init__(self, node_id: int, cluster: "Cluster", config: Configuration) -> None:
+        self.node_id = node_id
+        self.cluster = cluster
+        self.config = config
+        self.app = TestApp(node_id, cluster)
+        self.wal_backing: list[bytes] = []
+        self.consensus: Optional[Consensus] = None
+        self.running = False
+
+    def start(self) -> None:
+        comm = self.cluster.network.register(self.node_id, self._on_message)
+        last = self.app.ledger[-1] if self.app.ledger else None
+        self.consensus = Consensus(
+            config=self.config,
+            scheduler=self.cluster.scheduler,
+            comm=comm,
+            application=self.app,
+            assembler=self.app,
+            wal=MemWAL(self.wal_backing),
+            signer=self.app,
+            verifier=self.app,
+            request_inspector=self.app.inspector,
+            synchronizer=self.app,
+            wal_initial_content=list(self.wal_backing),
+            last_proposal=last.proposal if last else None,
+            last_signatures=last.signatures if last else (),
+        )
+        self.consensus.start()
+        self.running = True
+
+    def crash(self) -> None:
+        """Hard-stop: drop off the network and kill all components."""
+        self.running = False
+        self.cluster.network.unregister(self.node_id)
+        if self.consensus is not None:
+            self.consensus.stop()
+            self.consensus = None
+
+    def restart(self) -> None:
+        """Parity: reference test/test_app.go:130-143 (Restart)."""
+        if self.running:
+            self.crash()
+        self.start()
+
+    def submit(self, raw: bytes, on_done=None) -> None:
+        if self.consensus is not None:
+            self.consensus.submit_request(raw, on_done)
+
+    def _on_message(self, sender: int, payload, is_request: bool) -> None:
+        if self.consensus is None:
+            return
+        if is_request:
+            self.consensus.handle_request(sender, payload)
+        else:
+            self.consensus.handle_message(sender, payload)
+
+
+class Cluster:
+    """n replicas over a simulated network on one virtual clock."""
+
+    def __init__(
+        self,
+        n: int = 4,
+        *,
+        seed: int = 0,
+        config_tweaks: Optional[dict] = None,
+        leader_rotation: bool = False,
+    ) -> None:
+        self.scheduler = SimScheduler()
+        self.network = SimNetwork(self.scheduler, seed=seed)
+        self.network.membership = list(range(1, n + 1))
+        self.nodes: dict[int, Node] = {}
+        #: proposal-digest -> Reconfig to report on delivery (reconfig tests).
+        self._reconfigs: dict[str, Reconfig] = {}
+        tweaks = dict(config_tweaks or {})
+        for node_id in range(1, n + 1):
+            cfg = Configuration(
+                self_id=node_id,
+                leader_rotation=leader_rotation,
+                decisions_per_leader=tweaks.pop("decisions_per_leader", 3)
+                if leader_rotation
+                else 0,
+                **tweaks,
+            )
+            tweaks = dict(config_tweaks or {})  # fresh copy per node
+            self.nodes[node_id] = Node(node_id, self, cfg)
+
+    def start(self) -> None:
+        for node in self.nodes.values():
+            node.start()
+
+    # --- app-level cluster state ------------------------------------------
+
+    def longest_ledger(self, *, exclude: int) -> list[Decision]:
+        best: list[Decision] = []
+        for node_id, node in self.nodes.items():
+            if node_id == exclude or not node.running:
+                continue
+            if len(node.app.ledger) > len(best):
+                best = node.app.ledger
+        return list(best)
+
+    def reconfig_of(self, proposal: Proposal) -> Reconfig:
+        return self._reconfigs.get(proposal.digest(), Reconfig())
+
+    # --- driving -----------------------------------------------------------
+
+    def submit_to_all(self, raw: bytes) -> None:
+        for node in self.nodes.values():
+            if node.running:
+                node.submit(raw)
+
+    def ledgers_equal_len(self, expected: int, node_ids: Optional[Sequence[int]] = None) -> bool:
+        ids = node_ids or [i for i, nd in self.nodes.items() if nd.running]
+        return all(len(self.nodes[i].app.ledger) >= expected for i in ids)
+
+    def run_until_ledger(self, expected: int, *, max_time: float = 600.0, node_ids=None) -> bool:
+        return self.scheduler.run_until(
+            lambda: self.ledgers_equal_len(expected, node_ids), max_time=max_time
+        )
+
+    def assert_ledgers_consistent(self) -> None:
+        """Every pair of ledgers must agree on their common prefix."""
+        ledgers = [
+            [d.proposal.digest() for d in node.app.ledger]
+            for node in self.nodes.values()
+        ]
+        for i in range(len(ledgers)):
+            for j in range(i + 1, len(ledgers)):
+                common = min(len(ledgers[i]), len(ledgers[j]))
+                assert ledgers[i][:common] == ledgers[j][:common], (
+                    f"ledger fork between replicas {i + 1} and {j + 1}"
+                )
+
+
+__all__ = [
+    "Cluster",
+    "Node",
+    "TestApp",
+    "ByteInspector",
+    "MemWAL",
+    "make_request",
+    "pack_batch",
+    "unpack_batch",
+]

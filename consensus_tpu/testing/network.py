@@ -1,0 +1,144 @@
+"""In-process simulated network for multi-replica tests.
+
+Parity: reference test/network.go:34-253.  Every replica shares one
+SimScheduler, so message delivery interleaves deterministically with timers;
+fault-injection knobs mirror the reference:
+
+* per-node and per-link disconnection (``disconnect`` / ``disconnect_pair``)
+* probabilistic loss with a seeded RNG (``set_loss``)
+* message mutation hooks for byzantine-sender simulation (``mutate_send``,
+  reference test/test_app.go:180-191)
+* receiver-side selective filters (``lose_messages``)
+* per-link latency (``set_delay``)
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional, Sequence
+
+from consensus_tpu.api.deps import Comm
+from consensus_tpu.runtime.scheduler import SimScheduler
+from consensus_tpu.wire import ConsensusMessage
+
+
+class SimNetwork:
+    """Routes messages between registered replicas over the shared clock."""
+
+    def __init__(self, scheduler: SimScheduler, *, seed: int = 0, default_delay: float = 0.001) -> None:
+        self.scheduler = scheduler
+        self.rng = random.Random(seed)
+        self.default_delay = default_delay
+        self._handlers: dict[int, Callable[[int, object, bool], None]] = {}
+        #: Configured cluster membership (stable across crashes); falls back
+        #: to the live registration set when unset.
+        self.membership: Optional[list[int]] = None
+        self._disconnected: set[int] = set()
+        self._cut_links: set[tuple[int, int]] = set()
+        self._loss: dict[tuple[int, int], float] = {}
+        self._delay: dict[tuple[int, int], float] = {}
+        #: fn(sender, target, msg) -> msg | None (None drops the message).
+        self.mutate_send: Optional[Callable[[int, int, object], Optional[object]]] = None
+        #: fn(target, sender, msg) -> bool; True drops at the receiver.
+        self.lose_messages: Optional[Callable[[int, int, object], bool]] = None
+
+    # --- membership --------------------------------------------------------
+
+    def register(
+        self, node_id: int, on_message: Callable[[int, object, bool], None]
+    ) -> "NodeComm":
+        """``on_message(sender, payload, is_request)`` is the replica ingress."""
+        self._handlers[node_id] = on_message
+        return NodeComm(self, node_id)
+
+    def unregister(self, node_id: int) -> None:
+        self._handlers.pop(node_id, None)
+
+    def node_ids(self) -> list[int]:
+        if self.membership is not None:
+            return sorted(self.membership)
+        return sorted(self._handlers)
+
+    # --- fault injection ---------------------------------------------------
+
+    def disconnect(self, node_id: int) -> None:
+        self._disconnected.add(node_id)
+
+    def connect(self, node_id: int) -> None:
+        self._disconnected.discard(node_id)
+
+    def disconnect_pair(self, a: int, b: int) -> None:
+        self._cut_links.add((a, b))
+        self._cut_links.add((b, a))
+
+    def connect_pair(self, a: int, b: int) -> None:
+        self._cut_links.discard((a, b))
+        self._cut_links.discard((b, a))
+
+    def partition(self, group: Sequence[int]) -> None:
+        """Cut every link crossing the boundary of ``group``."""
+        inside = set(group)
+        for a in self.node_ids():
+            for b in self.node_ids():
+                if (a in inside) != (b in inside):
+                    self._cut_links.add((a, b))
+
+    def heal(self) -> None:
+        self._cut_links.clear()
+        self._disconnected.clear()
+        self._loss.clear()
+
+    def set_loss(self, a: int, b: int, probability: float) -> None:
+        """Drop a fraction of messages on the directed link a->b."""
+        self._loss[(a, b)] = probability
+
+    def set_delay(self, a: int, b: int, delay: float) -> None:
+        self._delay[(a, b)] = delay
+
+    # --- transport ---------------------------------------------------------
+
+    def send(self, sender: int, target: int, payload, *, is_request: bool) -> None:
+        if sender in self._disconnected or target in self._disconnected:
+            return
+        if (sender, target) in self._cut_links:
+            return
+        loss = self._loss.get((sender, target), 0.0)
+        if loss and self.rng.random() < loss:
+            return
+        if self.mutate_send is not None:
+            payload = self.mutate_send(sender, target, payload)
+            if payload is None:
+                return
+        delay = self._delay.get((sender, target), self.default_delay)
+
+        def deliver() -> None:
+            handler = self._handlers.get(target)
+            if handler is None:
+                return  # crashed / removed meanwhile
+            if self.lose_messages is not None and self.lose_messages(
+                target, sender, payload
+            ):
+                return
+            handler(sender, payload, is_request)
+
+        self.scheduler.call_later(delay, deliver, name=f"net {sender}->{target}")
+
+
+class NodeComm(Comm):
+    """The api.Comm a replica plugs in: fire-and-forget over the network."""
+
+    def __init__(self, network: SimNetwork, node_id: int) -> None:
+        self._network = network
+        self.node_id = node_id
+
+    def send_consensus(self, target_id: int, message: ConsensusMessage) -> None:
+        self._network.send(self.node_id, target_id, message, is_request=False)
+
+    def send_transaction(self, target_id: int, request: bytes) -> None:
+        self._network.send(self.node_id, target_id, request, is_request=True)
+
+    def nodes(self) -> Sequence[int]:
+        return self._network.node_ids()
+
+
+__all__ = ["SimNetwork", "NodeComm"]

@@ -259,7 +259,7 @@ class WriteAheadLog:
             rtype, flags = payload[0], payload[1]
             if first_record:
                 # Every segment must open with an anchor matching the chain.
-                if rtype != _TYPE_ANCHOR:
+                if rtype != _TYPE_ANCHOR or len(payload) < 6:
                     raise CorruptLogError(
                         "segment missing anchor", segment=name, offset=off, entries=entries
                     )
@@ -298,9 +298,14 @@ class WriteAheadLog:
 
 def repair(directory: str) -> None:
     """Chop a torn tail: truncate the damaged segment after its last intact
-    record (taking a ``.bak`` copy first) and delete any later segments.
+    record (taking a ``.bak`` copy first).
 
-    Parity: reference pkg/wal/writeaheadlog.go:293-337.
+    Only the *last* segment can legitimately be torn (a crash mid-append);
+    corruption in an earlier, fully-fsynced segment means durable records
+    were damaged at rest — silently discarding them would make the replica
+    forget messages it already broadcast, so that case raises for operator
+    intervention instead.  Parity: reference pkg/wal/writeaheadlog.go:293-337
+    (verifies all-but-last, truncates only the last file).
     """
     probe = WriteAheadLog(directory)
     try:
@@ -310,6 +315,11 @@ def repair(directory: str) -> None:
         bad_segment, offset = err.segment, err.offset
 
     segments = _list_segments(directory)
+    if segments and bad_segment != segments[-1][1]:
+        raise WALError(
+            f"corruption in non-tail segment {bad_segment!r}: durable records "
+            "are damaged; refusing to auto-repair"
+        )
     path = os.path.join(directory, bad_segment)
     backup = path + ".bak"
     with open(path, "rb") as src, open(backup, "wb") as dst:
@@ -324,11 +334,6 @@ def repair(directory: str) -> None:
             f.truncate(offset)
             f.flush()
             os.fsync(f.fileno())
-    # Anything after the damaged segment is unreachable through the chain.
-    bad_index = int(_SEGMENT_RE.match(bad_segment).group(1))
-    for index, name in segments:
-        if index > bad_index:
-            os.unlink(os.path.join(directory, name))
     _fsync_dir(directory)
 
 

@@ -1,0 +1,198 @@
+"""Unit tests for the heartbeat role machine and the state collector.
+
+Parity model: reference internal/bft/heartbeatmonitor_test.go and
+statecollector_test.go.
+"""
+
+from consensus_tpu.core.collector import StateCollector
+from consensus_tpu.core.heartbeat import HeartbeatMonitor, Role
+from consensus_tpu.runtime import SimScheduler
+from consensus_tpu.wire import HeartBeat, HeartBeatResponse, StateTransferResponse
+
+
+class FakeComm:
+    def __init__(self):
+        self.broadcasts = []
+        self.sent = []
+
+    def broadcast(self, msg):
+        self.broadcasts.append(msg)
+
+    def send(self, target_id, msg):
+        self.sent.append((target_id, msg))
+
+
+class FakeHandler:
+    def __init__(self):
+        self.timeouts = []
+        self.syncs = 0
+
+    def on_heartbeat_timeout(self, view, leader_id):
+        self.timeouts.append((view, leader_id))
+
+    def sync(self):
+        self.syncs += 1
+
+
+def make_monitor(s, *, view_seq=(True, 0), timeout=10.0, count=10, n=4, behind=3):
+    comm, handler = FakeComm(), FakeHandler()
+    hm = HeartbeatMonitor(
+        s,
+        comm=comm,
+        handler=handler,
+        n=n,
+        heartbeat_timeout=timeout,
+        heartbeat_count=count,
+        num_of_ticks_behind_before_syncing=behind,
+        view_sequence=lambda: view_seq,
+    )
+    return hm, comm, handler
+
+
+def test_leader_emits_heartbeats_every_tick_window():
+    s = SimScheduler()
+    hm, comm, _ = make_monitor(s)
+    hm.change_role(Role.LEADER, view=2, leader_id=1)
+    s.advance(3.0)  # 3 tick periods of 1s
+    hb = [m for m in comm.broadcasts if isinstance(m, HeartBeat)]
+    assert len(hb) >= 2
+    assert all(m.view == 2 for m in hb)
+    hm.close()
+    n = len(comm.broadcasts)
+    s.advance(5.0)
+    assert len(comm.broadcasts) == n  # closed -> silent
+
+
+def test_leader_suppresses_heartbeat_after_protocol_send():
+    s = SimScheduler()
+    hm, comm, _ = make_monitor(s)
+    hm.change_role(Role.LEADER, view=0, leader_id=1)
+    for _ in range(5):
+        hm.heartbeat_was_sent()
+        s.advance(1.0)
+    assert [m for m in comm.broadcasts if isinstance(m, HeartBeat)] == []
+
+
+def test_follower_times_out_and_complains_once():
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s, timeout=10.0)
+    hm.change_role(Role.FOLLOWER, view=1, leader_id=3)
+    s.advance(9.0)
+    assert handler.timeouts == []
+    s.advance(2.0)
+    assert handler.timeouts == [(1, 3)]
+    s.advance(20.0)
+    assert handler.timeouts == [(1, 3)]  # complained once, not repeatedly
+    hm.close()
+
+
+def test_follower_heartbeats_keep_it_alive():
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s, timeout=10.0)
+    hm.change_role(Role.FOLLOWER, view=1, leader_id=3)
+    for _ in range(30):
+        s.advance(1.0)
+        hm.process_msg(3, HeartBeat(view=1, seq=0))
+    assert handler.timeouts == []
+    hm.close()
+
+
+def test_follower_behind_for_n_ticks_syncs():
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s, view_seq=(True, 4), behind=3)
+    hm.change_role(Role.FOLLOWER, view=0, leader_id=3)
+    # Leader reports seq 5 = ours+1 repeatedly.
+    for _ in range(4):
+        hm.process_msg(3, HeartBeat(view=0, seq=5))
+        s.advance(1.0)
+    assert handler.syncs >= 1
+    hm.close()
+
+
+def test_heartbeat_from_higher_view_triggers_sync():
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s)
+    hm.change_role(Role.FOLLOWER, view=1, leader_id=3)
+    hm.process_msg(3, HeartBeat(view=5, seq=0))
+    assert handler.syncs == 1
+
+
+def test_stale_view_heartbeat_answered_with_response():
+    s = SimScheduler()
+    hm, comm, _ = make_monitor(s)
+    hm.change_role(Role.FOLLOWER, view=3, leader_id=2)
+    hm.process_msg(4, HeartBeat(view=1, seq=0))
+    assert comm.sent == [(4, HeartBeatResponse(view=3))]
+
+
+def test_leader_syncs_on_f_plus_one_higher_view_responses():
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s, n=4)  # f=1 -> need 2
+    hm.change_role(Role.LEADER, view=1, leader_id=1)
+    hm.process_msg(2, HeartBeatResponse(view=4))
+    assert handler.syncs == 0
+    hm.process_msg(3, HeartBeatResponse(view=4))
+    assert handler.syncs == 1
+    hm.process_msg(4, HeartBeatResponse(view=4))
+    assert handler.syncs == 1  # sync requested once
+
+
+def test_non_leader_heartbeats_ignored():
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s, timeout=5.0)
+    hm.change_role(Role.FOLLOWER, view=1, leader_id=3)
+    for _ in range(10):
+        s.advance(1.0)
+        hm.process_msg(4, HeartBeat(view=1, seq=0))  # not the leader
+    assert handler.timeouts, "non-leader heartbeats must not reset the timer"
+    hm.close()
+
+
+# --- collector -------------------------------------------------------------
+
+
+def test_collector_agrees_on_f_plus_one():
+    s = SimScheduler()
+    c = StateCollector(s, n=4, collect_timeout=1.0)
+    results = []
+    c.begin(results.append)
+    c.handle_response(2, StateTransferResponse(view_num=3, sequence=7))
+    assert results == []
+    c.handle_response(3, StateTransferResponse(view_num=3, sequence=7))
+    assert results == [(3, 7)]
+    # Late response after the window closed is ignored.
+    c.handle_response(4, StateTransferResponse(view_num=9, sequence=9))
+    assert results == [(3, 7)]
+
+
+def test_collector_timeout_yields_none():
+    s = SimScheduler()
+    c = StateCollector(s, n=4, collect_timeout=1.0)
+    results = []
+    c.begin(results.append)
+    c.handle_response(2, StateTransferResponse(view_num=1, sequence=1))
+    c.handle_response(3, StateTransferResponse(view_num=2, sequence=2))  # disagree
+    s.advance(1.5)
+    assert results == [None]
+
+
+def test_collector_dedups_by_sender():
+    s = SimScheduler()
+    c = StateCollector(s, n=4, collect_timeout=1.0)
+    results = []
+    c.begin(results.append)
+    c.handle_response(2, StateTransferResponse(view_num=3, sequence=7))
+    c.handle_response(2, StateTransferResponse(view_num=3, sequence=7))
+    assert results == []  # same sender twice is one vote
+
+
+def test_collector_new_begin_supersedes_old():
+    s = SimScheduler()
+    c = StateCollector(s, n=4, collect_timeout=5.0)
+    first, second = [], []
+    c.begin(first.append)
+    c.begin(second.append)
+    assert first == [None]
+    c.handle_response(2, StateTransferResponse(view_num=1, sequence=1))
+    c.handle_response(3, StateTransferResponse(view_num=1, sequence=1))
+    assert second == [(1, 1)]

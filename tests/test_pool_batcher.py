@@ -1,0 +1,249 @@
+"""RequestPool + Batcher tests on a simulated clock: cascade firing order,
+back-pressure parking, dedup, prune, and early/timed batch completion.
+
+Parity model: reference internal/bft/requestpool_test.go and batcher_test.go.
+"""
+
+import pytest
+
+from consensus_tpu.api.deps import RequestInspector
+from consensus_tpu.core import Batcher, PoolOptions, RequestPool
+from consensus_tpu.runtime import SimScheduler
+from consensus_tpu.types import RequestInfo
+
+
+class ByteInspector(RequestInspector):
+    """request bytes "client:reqid|payload" -> RequestInfo."""
+
+    def request_id(self, raw_request: bytes) -> RequestInfo:
+        head = raw_request.split(b"|", 1)[0].decode()
+        client, _, rid = head.partition(":")
+        return RequestInfo(client_id=client, request_id=rid)
+
+
+class RecordingHandler:
+    def __init__(self):
+        self.events = []
+
+    def on_request_timeout(self, raw, info):
+        self.events.append(("forward", info.request_id))
+
+    def on_leader_fwd_request_timeout(self, raw, info):
+        self.events.append(("complain", info.request_id))
+
+    def on_auto_remove_timeout(self, info):
+        self.events.append(("auto-remove", info.request_id))
+
+
+def req(i: int, pad: int = 0) -> bytes:
+    return f"c:{i}|".encode() + b"x" * pad
+
+
+def make_pool(sched, **opt_kw):
+    handler = RecordingHandler()
+    opts = PoolOptions(
+        pool_size=opt_kw.pop("pool_size", 4),
+        submit_timeout=opt_kw.pop("submit_timeout", 1.0),
+        forward_timeout=opt_kw.pop("forward_timeout", 2.0),
+        complain_timeout=opt_kw.pop("complain_timeout", 20.0),
+        auto_remove_timeout=opt_kw.pop("auto_remove_timeout", 60.0),
+        **opt_kw,
+    )
+    pool = RequestPool(sched, ByteInspector(), opts, timeout_handler=handler)
+    return pool, handler
+
+
+def test_submit_dedup_and_fifo_order():
+    s = SimScheduler()
+    pool, _ = make_pool(s)
+    results = []
+    pool.submit(req(1), results.append)
+    pool.submit(req(2), results.append)
+    pool.submit(req(1), results.append)  # duplicate
+    assert results == [None, None, "request already exists"]
+    assert pool.next_requests(10, 10**6) == [req(1), req(2)]
+
+
+def test_cascade_fires_in_order_forward_complain_remove():
+    s = SimScheduler()
+    pool, handler = make_pool(s)
+    pool.submit(req(7))
+    s.advance(2.0)  # forward timeout
+    assert handler.events == [("forward", "7")]
+    s.advance(20.0)  # + complain timeout
+    assert handler.events == [("forward", "7"), ("complain", "7")]
+    s.advance(60.0)  # + auto-remove timeout
+    assert handler.events == [
+        ("forward", "7"),
+        ("complain", "7"),
+        ("auto-remove", "7"),
+    ]
+    assert pool.count == 0
+
+
+def test_remove_cancels_cascade():
+    s = SimScheduler()
+    pool, handler = make_pool(s)
+    pool.submit(req(1))
+    assert pool.remove_request(RequestInfo("c", "1"))
+    s.advance(1000.0)
+    assert handler.events == []
+
+
+def test_stop_and_restart_timers():
+    s = SimScheduler()
+    pool, handler = make_pool(s)
+    pool.submit(req(1))
+    pool.stop_timers()
+    s.advance(100.0)
+    assert handler.events == []  # frozen during view change
+    pool.restart_timers()
+    s.advance(2.0)
+    assert handler.events == [("forward", "1")]
+
+
+def test_full_pool_parks_then_admits_on_space():
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=2)
+    results = {}
+    pool.submit(req(1), lambda e: results.update(r1=e))
+    pool.submit(req(2), lambda e: results.update(r2=e))
+    pool.submit(req(3), lambda e: results.update(r3=e))
+    s.advance(0.5)
+    assert "r3" not in results  # parked
+    pool.remove_request(RequestInfo("c", "1"))
+    s.advance(0.0)
+    assert results["r3"] is None
+    assert pool.count == 2
+
+
+def test_full_pool_submit_times_out():
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=1, submit_timeout=1.0)
+    results = []
+    pool.submit(req(1))
+    pool.submit(req(2), results.append)
+    s.advance(1.1)
+    assert results == ["submit timed out: pool is full"]
+
+
+def test_deleted_requests_resubmittable_after_retention():
+    s = SimScheduler()
+    pool, _ = make_pool(s)
+    pool.submit(req(1))
+    pool.remove_request(RequestInfo("c", "1"))
+    results = []
+    pool.submit(req(1), results.append)
+    assert results == ["request already exists"]  # still in dedup window
+    s.advance(6.0)  # past DELETED_RETENTION_SECONDS
+    pool.submit(req(1), results.append)
+    assert results == ["request already exists", None]
+
+
+def test_oversized_request_rejected():
+    s = SimScheduler()
+    pool, _ = make_pool(s, request_max_bytes=16)
+    results = []
+    pool.submit(b"c:1|" + b"y" * 100, results.append)
+    assert results and "exceeds max" in results[0]
+
+
+def test_prune_drops_failing_requests():
+    s = SimScheduler()
+    pool, _ = make_pool(s)
+    for i in range(3):
+        pool.submit(req(i))
+    pool.prune(lambda raw: raw != req(1))
+    assert pool.next_requests(10, 10**6) == [req(0), req(2)]
+
+
+def test_next_requests_respects_count_and_bytes():
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=10, request_max_bytes=1000)
+    for i in range(5):
+        pool.submit(req(i, pad=100))
+    assert len(pool.next_requests(3, 10**6)) == 3
+    batch = pool.next_requests(10, 250)
+    assert len(batch) == 2  # ~104 bytes each; the first always fits
+    assert len(pool.next_requests(10, 1)) == 1
+
+
+def test_batcher_immediate_when_pool_full_enough():
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=10)
+    b = Batcher(s, pool, batch_max_count=2, batch_max_bytes=10**6, batch_max_interval=0.05)
+    pool.submit(req(1))
+    pool.submit(req(2))
+    got = []
+    b.next_batch(got.append)
+    assert got == [[req(1), req(2)]]
+
+
+def test_batcher_interval_returns_partial_batch():
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=10)
+    b = Batcher(s, pool, batch_max_count=5, batch_max_bytes=10**6, batch_max_interval=0.05)
+    pool.submit(req(1))
+    got = []
+    b.next_batch(got.append)
+    assert got == []
+    s.advance(0.05)
+    assert got == [[req(1)]]
+
+
+def test_batcher_completes_early_when_pool_tops_up():
+    s = SimScheduler()
+    pool_holder = {}
+
+    def on_submitted():
+        pool_holder["batcher"].pool_changed()
+
+    opts = PoolOptions(pool_size=10)
+    pool = RequestPool(s, ByteInspector(), opts, on_submitted=on_submitted)
+    b = Batcher(s, pool, batch_max_count=2, batch_max_bytes=10**6, batch_max_interval=5.0)
+    pool_holder["batcher"] = b
+    got = []
+    pool.submit(req(1))
+    b.next_batch(got.append)
+    assert got == []
+    pool.submit(req(2))  # tops up to batch_max_count
+    assert got == [[req(1), req(2)]]
+    assert s.now() < 5.0  # did not wait for the interval
+
+
+def test_batcher_close_unblocks_with_empty_and_reset_reopens():
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=10)
+    b = Batcher(s, pool, batch_max_count=2, batch_max_bytes=10**6, batch_max_interval=1.0)
+    got = []
+    b.next_batch(got.append)
+    b.close()
+    assert got == [[]]
+    s.advance(2.0)
+    assert got == [[]]  # timer was cancelled
+    b.reset()
+    pool.submit(req(1))
+    pool.submit(req(2))
+    b.next_batch(got.append)
+    assert got == [[], [req(1), req(2)]]
+
+
+def test_batcher_rejects_concurrent_requests():
+    s = SimScheduler()
+    pool, _ = make_pool(s)
+    b = Batcher(s, pool, batch_max_count=2, batch_max_bytes=10**6, batch_max_interval=1.0)
+    b.next_batch(lambda _: None)
+    with pytest.raises(RuntimeError):
+        b.next_batch(lambda _: None)
+
+
+def test_pool_close_fails_parked_submissions():
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=1)
+    results = []
+    pool.submit(req(1))
+    pool.submit(req(2), results.append)
+    pool.close()
+    assert results == ["pool closed"]
+    pool.submit(req(3), results.append)
+    assert results[-1] == "pool closed"

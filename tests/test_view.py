@@ -1,0 +1,438 @@
+"""View state-machine tests with mocked collaborators: the 3-phase walk,
+WAL-before-send ordering, batched commit verification, pipelining, assist
+replies, censorship detection, and metadata/blacklist validation.
+
+Parity model: reference internal/bft/view_test.go (TestNormalPath:533 and
+friends), restructured for the event-driven design.
+"""
+
+import pytest
+
+from consensus_tpu.api.deps import Signer, Verifier
+from consensus_tpu.core.view import Phase, View
+from consensus_tpu.runtime import SimScheduler
+from consensus_tpu.types import Checkpoint, Proposal, RequestInfo, Signature
+from consensus_tpu.wire import (
+    Commit,
+    PrePrepare,
+    Prepare,
+    PreparesFrom,
+    ProposedRecord,
+    SavedCommit,
+    encode_prepares_from,
+)
+
+NODES = (1, 2, 3, 4)
+N = 4  # => quorum 3, f 1
+
+
+def sig_for(node_id: int, aux: bytes = b"") -> Signature:
+    return Signature(id=node_id, value=b"sig-%d" % node_id, msg=aux)
+
+
+class FakeVerifier(Verifier):
+    def __init__(self):
+        self.vseq = 0
+        self.batch_calls = []
+
+    def verify_proposal(self, proposal):
+        if proposal.payload.startswith(b"BAD"):
+            raise ValueError("application rejected proposal")
+        return [RequestInfo("c", str(i)) for i in range(3)]
+
+    def verify_request(self, raw):
+        return RequestInfo("c", raw.decode())
+
+    def verify_consenter_sig(self, signature, proposal):
+        if signature.value != b"sig-%d" % signature.id:
+            raise ValueError("bad signature")
+        return signature.msg
+
+    def verify_signature(self, signature):
+        if signature.value != b"sig-%d" % signature.id:
+            raise ValueError("bad signature")
+
+    def verification_sequence(self):
+        return self.vseq
+
+    def requests_from_proposal(self, proposal):
+        return []
+
+    def auxiliary_data(self, msg):
+        return msg
+
+    def verify_consenter_sigs_batch(self, signatures, proposal):
+        self.batch_calls.append(len(signatures))
+        return super().verify_consenter_sigs_batch(signatures, proposal)
+
+
+class FakeSigner(Signer):
+    def __init__(self, self_id):
+        self.self_id = self_id
+
+    def sign(self, data):
+        return b"sig-%d" % self.self_id
+
+    def sign_proposal(self, proposal, aux=b""):
+        return Signature(id=self.self_id, value=b"sig-%d" % self.self_id, msg=aux)
+
+
+class FakeComm:
+    def __init__(self):
+        self.broadcasts = []
+        self.sent = []
+
+    def broadcast(self, msg):
+        self.broadcasts.append(msg)
+
+    def send(self, target_id, msg):
+        self.sent.append((target_id, msg))
+
+
+class FakeState:
+    def __init__(self):
+        self.saved = []
+
+    def save(self, record):
+        self.saved.append(record)
+
+
+class FakeDecider:
+    def __init__(self):
+        self.decisions = []
+
+    def decide(self, proposal, signatures, requests):
+        self.decisions.append((proposal, tuple(signatures), tuple(requests)))
+
+
+class FakeFD:
+    def __init__(self):
+        self.complaints = []
+
+    def complain(self, view, stop_view):
+        self.complaints.append((view, stop_view))
+
+
+class FakeSync:
+    def __init__(self):
+        self.calls = 0
+
+    def sync(self):
+        self.calls += 1
+
+
+class Harness:
+    def __init__(self, self_id=2, leader_id=1, view_number=0, decisions_per_leader=0):
+        self.sched = SimScheduler()
+        self.verifier = FakeVerifier()
+        self.signer = FakeSigner(self_id)
+        self.comm = FakeComm()
+        self.state = FakeState()
+        self.decider = FakeDecider()
+        self.fd = FakeFD()
+        self.sync = FakeSync()
+        self.checkpoint = Checkpoint()
+        self.view = View(
+            scheduler=self.sched,
+            self_id=self_id,
+            number=view_number,
+            leader_id=leader_id,
+            proposal_sequence=0,
+            decisions_in_view=0,
+            n=N,
+            nodes=NODES,
+            comm=self.comm,
+            verifier=self.verifier,
+            signer=self.signer,
+            state=self.state,
+            decider=self.decider,
+            failure_detector=self.fd,
+            sync_requester=self.sync,
+            checkpoint=self.checkpoint,
+            decisions_per_leader=decisions_per_leader,
+        )
+
+    def make_proposal(self, payload=b"batch", seq=None):
+        md = self.view.get_metadata()
+        return Proposal(payload=payload, metadata=md, verification_sequence=0)
+
+    def pre_prepare(self, proposal, seq=0, view=0, prev_sigs=()):
+        return PrePrepare(
+            view=view, seq=seq, proposal=proposal, prev_commit_signatures=tuple(prev_sigs)
+        )
+
+
+def walk_to_prepared(h: Harness, proposal):
+    h.view.handle_message(1, h.pre_prepare(proposal))
+    assert h.view.phase == Phase.PROPOSED
+    digest = proposal.digest()
+    h.view.handle_message(3, Prepare(view=0, seq=0, digest=digest))
+    h.view.handle_message(4, Prepare(view=0, seq=0, digest=digest))
+    assert h.view.phase == Phase.PREPARED
+
+
+def test_normal_path_follower_decides():
+    h = Harness(self_id=2, leader_id=1)
+    proposal = h.make_proposal()
+    digest = proposal.digest()
+
+    walk_to_prepared(h, proposal)
+
+    # WAL-before-send: ProposedRecord saved before our prepare broadcast,
+    # SavedCommit before our commit broadcast.
+    assert isinstance(h.state.saved[0], ProposedRecord)
+    assert isinstance(h.state.saved[1], SavedCommit)
+    kinds = [type(m).__name__ for m in h.comm.broadcasts]
+    assert kinds == ["Prepare", "Commit"]
+
+    h.view.handle_message(3, Commit(view=0, seq=0, digest=digest, signature=sig_for(3)))
+    assert h.decider.decisions == []  # quorum-1=2 commits needed
+    h.view.handle_message(4, Commit(view=0, seq=0, digest=digest, signature=sig_for(4)))
+
+    assert len(h.decider.decisions) == 1
+    decided, sigs, requests = h.decider.decisions[0]
+    assert decided == proposal
+    assert sorted(s.id for s in sigs) == [2, 3, 4]  # peers + own
+    assert len(requests) == 3
+    assert h.view.proposal_sequence == 1
+    assert h.view.phase == Phase.COMMITTED
+
+
+def test_leader_broadcasts_pre_prepare_after_persisting():
+    h = Harness(self_id=1, leader_id=1)
+    proposal = h.make_proposal()
+    h.view.propose(proposal)
+    assert h.view.phase == Phase.PROPOSED
+    # Leader order: persist, then reveal the pre-prepare, then prepare.
+    assert isinstance(h.state.saved[0], ProposedRecord)
+    kinds = [type(m).__name__ for m in h.comm.broadcasts]
+    assert kinds == ["PrePrepare", "Prepare"]
+
+
+def test_bad_proposal_complains_and_aborts():
+    h = Harness()
+    bad = Proposal(payload=b"BAD", metadata=h.view.get_metadata())
+    h.view.handle_message(1, h.pre_prepare(bad))
+    assert h.fd.complaints == [(0, False)]
+    assert h.sync.calls == 1
+    assert h.view.phase == Phase.ABORT
+    assert h.state.saved == []
+
+
+def test_metadata_view_mismatch_rejected():
+    h = Harness()
+    proposal = h.make_proposal()
+    # Tamper: metadata claims view 5.
+    other = Harness(view_number=5, leader_id=1)
+    tampered = Proposal(payload=b"x", metadata=other.view.get_metadata())
+    h.view.handle_message(1, h.pre_prepare(tampered))
+    assert h.view.phase == Phase.ABORT
+    assert h.fd.complaints
+
+
+def test_verification_sequence_mismatch_rejected():
+    h = Harness()
+    proposal = Proposal(
+        payload=b"x", metadata=h.view.get_metadata(), verification_sequence=9
+    )
+    h.view.handle_message(1, h.pre_prepare(proposal))
+    assert h.view.phase == Phase.ABORT
+
+
+def test_pre_prepare_from_non_leader_ignored():
+    h = Harness()
+    proposal = h.make_proposal()
+    h.view.handle_message(3, h.pre_prepare(proposal))
+    assert h.view.phase == Phase.COMMITTED
+    assert h.state.saved == []
+
+
+def test_wrong_digest_prepares_dont_count():
+    h = Harness()
+    proposal = h.make_proposal()
+    h.view.handle_message(1, h.pre_prepare(proposal))
+    h.view.handle_message(3, Prepare(view=0, seq=0, digest="bogus"))
+    assert h.view.phase == Phase.PROPOSED
+    # One vote per sender (parity with the reference voteSet): node 3's
+    # later, corrected prepare is ignored — the first vote stands.
+    h.view.handle_message(3, Prepare(view=0, seq=0, digest=proposal.digest()))
+    assert h.view.phase == Phase.PROPOSED
+    # Votes from other nodes complete the quorum (leader also prepares).
+    h.view.handle_message(1, Prepare(view=0, seq=0, digest=proposal.digest()))
+    h.view.handle_message(4, Prepare(view=0, seq=0, digest=proposal.digest()))
+    assert h.view.phase == Phase.PREPARED
+
+
+def test_commit_votes_verified_as_one_batch():
+    h = Harness()
+    proposal = h.make_proposal()
+    digest = proposal.digest()
+    walk_to_prepared(h, proposal)
+    assert h.verifier.batch_calls == []
+    h.view.handle_message(3, Commit(view=0, seq=0, digest=digest, signature=sig_for(3)))
+    # One vote < quorum-1: the view keeps buffering, no verification yet.
+    assert h.verifier.batch_calls == []
+    h.view.handle_message(4, Commit(view=0, seq=0, digest=digest, signature=sig_for(4)))
+    # Both votes verified in a single batch call.
+    assert h.verifier.batch_calls == [2]
+    assert len(h.decider.decisions) == 1
+
+
+def test_invalid_commit_signature_dropped_waits_for_more():
+    h = Harness()
+    proposal = h.make_proposal()
+    digest = proposal.digest()
+    walk_to_prepared(h, proposal)
+    forged = Commit(
+        view=0, seq=0, digest=digest, signature=Signature(id=3, value=b"forged")
+    )
+    h.view.handle_message(3, forged)
+    h.view.handle_message(4, Commit(view=0, seq=0, digest=digest, signature=sig_for(4)))
+    assert h.decider.decisions == []  # forged vote rejected, still short
+    h.view.handle_message(1, Commit(view=0, seq=0, digest=digest, signature=sig_for(1)))
+    assert len(h.decider.decisions) == 1
+    _, sigs, _ = h.decider.decisions[0]
+    assert sorted(s.id for s in sigs) == [1, 2, 4]
+
+
+def test_commit_sender_must_match_signature_signer():
+    h = Harness()
+    proposal = h.make_proposal()
+    digest = proposal.digest()
+    walk_to_prepared(h, proposal)
+    # Node 3 relays node 4's signature: must not count as node 3's vote.
+    h.view.handle_message(3, Commit(view=0, seq=0, digest=digest, signature=sig_for(4)))
+    h.view.handle_message(4, Commit(view=0, seq=0, digest=digest, signature=sig_for(4)))
+    assert h.decider.decisions == []
+
+
+def test_pipelined_next_seq_messages_apply_after_decision():
+    h = Harness()
+    p0 = h.make_proposal()
+    d0 = p0.digest()
+
+    # Next-sequence proposal arrives early (leader pipelines seq 1).
+    md1_view = Harness()
+    md1_view.view.proposal_sequence = 1
+    md1_view.view.decisions_in_view = 1
+    p1 = Proposal(payload=b"b1", metadata=md1_view.view.get_metadata())
+    h.view.handle_message(1, h.pre_prepare(p1, seq=1))
+    h.view.handle_message(3, Prepare(view=0, seq=1, digest=p1.digest()))
+    h.view.handle_message(4, Prepare(view=0, seq=1, digest=p1.digest()))
+
+    # Now run sequence 0 to completion.
+    walk_to_prepared(h, p0)
+    h.view.handle_message(3, Commit(view=0, seq=0, digest=d0, signature=sig_for(3)))
+    h.view.handle_message(4, Commit(view=0, seq=0, digest=d0, signature=sig_for(4)))
+    assert len(h.decider.decisions) == 1
+
+    # The buffered seq-1 traffic drives the view to PREPARED via the
+    # scheduler continuation.
+    h.sched.run_until_idle(max_events=10)
+    assert h.view.proposal_sequence == 1
+    assert h.view.phase == Phase.PREPARED
+    h.view.handle_message(3, Commit(view=0, seq=1, digest=p1.digest(), signature=sig_for(3)))
+    h.view.handle_message(4, Commit(view=0, seq=1, digest=p1.digest(), signature=sig_for(4)))
+    assert len(h.decider.decisions) == 2
+
+
+def test_prev_seq_prepare_gets_assist_reply():
+    h = Harness()
+    p0 = h.make_proposal()
+    walk_to_prepared(h, p0)
+    h.view.handle_message(3, Commit(view=0, seq=0, digest=p0.digest(), signature=sig_for(3)))
+    h.view.handle_message(4, Commit(view=0, seq=0, digest=p0.digest(), signature=sig_for(4)))
+    assert h.view.proposal_sequence == 1
+
+    # A laggard still prepares seq 0: we re-send our prepare, marked assist.
+    h.view.handle_message(4, Prepare(view=0, seq=0, digest=p0.digest()))
+    assert h.comm.sent, "expected an assist reply"
+    target, reply = h.comm.sent[-1]
+    assert target == 4 and isinstance(reply, Prepare) and reply.assist
+    # Assist messages are not re-answered (no loops).
+    n_sent = len(h.comm.sent)
+    h.view.handle_message(4, Prepare(view=0, seq=0, digest=p0.digest(), assist=True))
+    assert len(h.comm.sent) == n_sent
+
+
+def test_censorship_detection_triggers_sync():
+    h = Harness()
+    # f+1 = 2 distinct nodes vote to commit a sequence far ahead of us.
+    ahead = Commit(view=0, seq=7, digest="d", signature=sig_for(3))
+    h.view.handle_message(3, ahead)
+    assert h.sync.calls == 0
+    h.view.handle_message(4, Commit(view=0, seq=7, digest="d", signature=sig_for(4)))
+    assert h.sync.calls == 1
+    assert h.view.stopped
+
+
+def test_wrong_view_from_leader_complains():
+    h = Harness()
+    h.view.handle_message(1, Prepare(view=3, seq=0, digest="d"))
+    assert h.fd.complaints == [(0, False)]
+    assert h.sync.calls == 1  # leader is ahead -> sync
+    assert h.view.stopped
+
+
+def test_wrong_view_from_non_leader_feeds_censorship_detector():
+    h = Harness()
+    h.view.handle_message(3, Commit(view=2, seq=5, digest="d", signature=sig_for(3)))
+    assert not h.view.stopped
+    h.view.handle_message(4, Commit(view=2, seq=5, digest="d", signature=sig_for(4)))
+    assert h.view.stopped and h.sync.calls == 1
+
+
+def test_rotation_blacklist_digest_must_bind():
+    # With rotation on, metadata must carry the digest of the previous
+    # commit signatures the leader included.
+    h = Harness(decisions_per_leader=3)
+    prev_proposal = Proposal(payload=b"prev", verification_sequence=0)
+    prev_sigs = (
+        sig_for(1, encode_prepares_from(PreparesFrom(ids=(2, 3)))),
+        sig_for(3, encode_prepares_from(PreparesFrom(ids=(2,)))),
+        sig_for(4, encode_prepares_from(PreparesFrom(ids=(2,)))),
+    )
+    h.checkpoint.set(prev_proposal, prev_sigs)
+    h.view.proposal_sequence = 1
+    h.view.decisions_in_view = 1
+
+    md = h.view.get_metadata()
+    good = Proposal(payload=b"x", metadata=md, verification_sequence=0)
+    # Leader must carry the prev sigs; digest in metadata must match them.
+    h.view.handle_message(1, h.pre_prepare(good, seq=1, prev_sigs=prev_sigs))
+    assert h.view.phase == Phase.PROPOSED
+
+    # Same metadata but truncated signature list -> digest mismatch -> abort.
+    h2 = Harness(decisions_per_leader=3)
+    h2.checkpoint.set(prev_proposal, prev_sigs)
+    h2.view.proposal_sequence = 1
+    h2.view.decisions_in_view = 1
+    bad = Proposal(payload=b"x", metadata=h2.view.get_metadata(), verification_sequence=0)
+    h2.view.handle_message(1, h2.pre_prepare(bad, seq=1, prev_sigs=prev_sigs[:1]))
+    assert h2.view.phase == Phase.ABORT
+
+
+def test_rotation_off_requires_empty_blacklist():
+    h = Harness(decisions_per_leader=0)
+    # Hand-build metadata with a non-empty blacklist.
+    from consensus_tpu.wire import ViewMetadata, encode_view_metadata
+
+    md = ViewMetadata(view_id=0, latest_sequence=0, decisions_in_view=0, black_list=(3,))
+    p = Proposal(payload=b"x", metadata=encode_view_metadata(md))
+    h.view.handle_message(1, h.pre_prepare(p))
+    assert h.view.phase == Phase.ABORT
+
+
+def test_restored_prepared_view_rebroadcasts_commit():
+    h = Harness()
+    proposal = h.make_proposal()
+    # Simulate WAL restore into PREPARED.
+    h.view.phase = Phase.PREPARED
+    h.view.in_flight_proposal = proposal
+    h.view.my_commit_signature = sig_for(2)
+    commit = Commit(
+        view=0, seq=0, digest=proposal.digest(), signature=sig_for(2), assist=True
+    )
+    h.view._curr_commit_sent = commit
+    h.view.start()
+    assert h.comm.broadcasts[-1] == commit

@@ -168,3 +168,37 @@ def test_append_after_close_fails(tmp_path):
     wal.close()
     with pytest.raises(WALError):
         wal.append(b"x")
+
+
+def test_corrupt_anchor_length_detected_not_crash(tmp_path):
+    # A bit-flip in an anchor's length field must surface as CorruptLogError
+    # (repairable), not a raw struct.error.
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog.create(d)
+    wal.append(b"x" * 8)
+    wal.close()
+    seg = sorted(f for f in os.listdir(d) if f.endswith(".wal"))[0]
+    path = os.path.join(d, seg)
+    buf = bytearray(open(path, "rb").read())
+    buf[0] = 2  # anchor payload length 6 -> 2
+    open(path, "wb").write(bytes(buf))
+    with pytest.raises(CorruptLogError):
+        WriteAheadLog(d).read_all()
+
+
+def test_non_tail_corruption_refuses_auto_repair(tmp_path):
+    # Damage in a fully-fsynced earlier segment is data loss, not a torn
+    # tail: repair must refuse rather than silently discard durable records.
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog.create(d, segment_max_bytes=200)
+    for e in entries_of(12, size=16):
+        wal.append(e)
+    wal.close()
+    segs = sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+    assert len(segs) >= 3
+    mid = os.path.join(d, segs[1])
+    buf = bytearray(open(mid, "rb").read())
+    buf[len(buf) // 2] ^= 0xFF
+    open(mid, "wb").write(bytes(buf))
+    with pytest.raises(WALError):
+        repair(d)
