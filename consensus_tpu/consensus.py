@@ -141,17 +141,13 @@ class Consensus:
         new_dec = dec + 1 if seq != 0 else 0
 
         self._restore_view_change = None
-        view_change = PersistedState(
-            self.wal, InFlightData(), self.wal_initial_content
-        ).load_view_change_if_applicable()
+        view_change = self.state.load_view_change_if_applicable()
         if view_change is not None and view_change.next_view >= view:
             logger.info("restoring pending view change to view %d", view_change.next_view)
             new_view = view_change.next_view
             self._restore_view_change = view_change
 
-        view_seq = PersistedState(
-            self.wal, InFlightData(), self.wal_initial_content
-        ).load_new_view_if_applicable()
+        view_seq = self.state.load_new_view_if_applicable()
         if view_seq is not None:
             nv_view, nv_seq = view_seq
             if nv_seq >= seq:
@@ -188,20 +184,28 @@ class Consensus:
         )
         self.controller = controller
 
-        pool = RequestPool(
-            self.scheduler,
-            self.request_inspector,
-            PoolOptions(
-                pool_size=cfg.request_pool_size,
-                request_max_bytes=cfg.request_max_bytes,
-                submit_timeout=cfg.submit_timeout,
-                forward_timeout=cfg.request_forward_timeout,
-                complain_timeout=cfg.request_complain_timeout,
-                auto_remove_timeout=cfg.request_auto_remove_timeout,
-            ),
-            timeout_handler=controller,
-            on_submitted=self._on_pool_submitted,
+        pool_options = PoolOptions(
+            pool_size=cfg.request_pool_size,
+            request_max_bytes=cfg.request_max_bytes,
+            submit_timeout=cfg.submit_timeout,
+            forward_timeout=cfg.request_forward_timeout,
+            complain_timeout=cfg.request_complain_timeout,
+            auto_remove_timeout=cfg.request_auto_remove_timeout,
         )
+        if getattr(self, "pool", None) is not None:
+            # Reconfiguration keeps the pool (and its queued requests),
+            # re-pointed at the new controller.  Parity: reference
+            # pkg/consensus/consensus.go:231 (Pool.ChangeOptions).
+            pool = self.pool
+            pool.change_options(timeout_handler=controller, options=pool_options)
+        else:
+            pool = RequestPool(
+                self.scheduler,
+                self.request_inspector,
+                pool_options,
+                timeout_handler=controller,
+                on_submitted=self._on_pool_submitted,
+            )
         self.pool = pool
         batcher = Batcher(
             self.scheduler,
@@ -261,6 +265,7 @@ class Consensus:
             view_change_timeout=cfg.view_change_timeout,
             leader_rotation=cfg.leader_rotation,
             decisions_per_leader=cfg.decisions_per_leader,
+            on_reconfig=self._on_reconfig,
         )
         self.controller.view_changer = self.view_changer
 

@@ -109,5 +109,10 @@ class Batcher:
         """Reopen after a view change.  Parity: reference batcher.go:81-92."""
         self._closed = False
 
+    @property
+    def closed(self) -> bool:
+        """Parity: reference batcher.go Closed()."""
+        return self._closed
+
 
 __all__ = ["Batcher"]

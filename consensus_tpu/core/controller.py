@@ -378,6 +378,10 @@ class Controller:
         if not self._leader_token or self._stopped or self._batch_outstanding:
             return
         self._leader_token = False
+        if self.batcher.closed:
+            # View change / sync in progress: the token is re-acquired when
+            # the next view starts (parity: reference controller.go:476).
+            return
         self._batch_outstanding = True
         self.batcher.next_batch(self._on_batch)
 
@@ -386,7 +390,8 @@ class Controller:
         if self._stopped:
             return
         if not batch:
-            self._acquire_leader_token()  # try again later
+            if not self.batcher.closed:
+                self._acquire_leader_token()  # try again later
             return
         if self.curr_view is None or self.curr_view.stopped:
             return
@@ -450,6 +455,11 @@ class Controller:
         reconfig = self._application.deliver(proposal, signatures)
         self.checkpoint.set(proposal, signatures)
         return reconfig
+
+    def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
+        """Checked delivery for the view changer (its ``Application`` is the
+        reference's MutuallyExclusiveDeliver wrapper — same guard here)."""
+        return self._deliver_checked(proposal, signatures)
 
     def _check_if_rotate(self, blacklist: Sequence[int]) -> bool:
         """Parity: reference controller.go:560-574 (called post-increment)."""

@@ -302,6 +302,22 @@ class RequestPool:
             logger.info("pruning request %s (failed re-validation)", info)
             self._delete(info.key())
 
+    def change_options(
+        self,
+        timeout_handler: Optional[RequestTimeoutHandler] = None,
+        options: Optional[PoolOptions] = None,
+    ) -> None:
+        """Re-point the pool at a new handler/config across reconfiguration,
+        keeping every queued request.
+
+        Parity: reference requestpool.go ChangeOptions (used by
+        pkg/consensus/consensus.go:231)."""
+        if timeout_handler is not None:
+            self._handler = timeout_handler
+        if options is not None:
+            self._opts = options
+        self._closed = False
+
     def close(self) -> None:
         self._closed = True
         self.stop_timers()

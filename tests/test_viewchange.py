@@ -1,0 +1,435 @@
+"""View-change tests: CheckInFlight condition tables, last-decision
+validation, and full-cluster leader-failure scenarios including the
+in-flight re-commit via the embedded PREPARED view.
+
+Parity model: reference internal/bft/viewchanger_test.go
+(TestCheckInFlight*:1667,1745, TestCommitInFlight:1907) and
+test/basic_test.go failover scenarios.
+"""
+
+import pytest
+
+from consensus_tpu.core.viewchanger import (
+    check_in_flight,
+    validate_in_flight,
+    validate_last_decision,
+)
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.types import Proposal, Signature
+from consensus_tpu.wire import Commit, ViewData, ViewMetadata, encode_view_metadata
+
+# n=4: f=1, quorum=3
+F, QUORUM = 1, 3
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 60.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+}
+
+
+def proposal_at(seq, view=0, payload=b"p"):
+    md = ViewMetadata(view_id=view, latest_sequence=seq)
+    return Proposal(payload=payload, metadata=encode_view_metadata(md))
+
+
+def vd(last_seq=None, in_flight=None, prepared=False, next_view=1):
+    last = proposal_at(last_seq) if last_seq is not None else Proposal()
+    return ViewData(
+        next_view=next_view,
+        last_decision=last,
+        in_flight_proposal=in_flight,
+        in_flight_prepared=prepared,
+    )
+
+
+class TestCheckInFlight:
+    def test_no_in_flight_anywhere_condition_b(self):
+        msgs = [vd(last_seq=5) for _ in range(3)]
+        ok, none_in_flight, proposal = check_in_flight(msgs, F, QUORUM)
+        assert ok and none_in_flight and proposal is None
+
+    def test_prepared_in_flight_agreed_condition_a(self):
+        p = proposal_at(6, payload=b"inflight")
+        msgs = [
+            vd(last_seq=5, in_flight=p, prepared=True),
+            vd(last_seq=5, in_flight=p, prepared=True),
+            vd(last_seq=5),
+        ]
+        ok, none_in_flight, proposal = check_in_flight(msgs, F, QUORUM)
+        assert ok and not none_in_flight and proposal == p
+
+    def test_single_prepared_witness_not_enough_for_a_but_b_holds(self):
+        # One prepared witness (< f+1): condition A fails; but the other
+        # quorum of no-in-flight messages satisfies B.
+        p = proposal_at(6)
+        msgs = [
+            vd(last_seq=5, in_flight=p, prepared=True),
+            vd(last_seq=5),
+            vd(last_seq=5),
+            vd(last_seq=5),
+        ]
+        ok, none_in_flight, proposal = check_in_flight(msgs, F, QUORUM)
+        assert ok and none_in_flight
+
+    def test_not_prepared_in_flight_counts_as_none(self):
+        p = proposal_at(6)
+        msgs = [
+            vd(last_seq=5, in_flight=p, prepared=False),
+            vd(last_seq=5, in_flight=p, prepared=False),
+            vd(last_seq=5),
+        ]
+        ok, none_in_flight, _ = check_in_flight(msgs, F, QUORUM)
+        assert ok and none_in_flight
+
+    def test_stale_sequence_in_flight_ignored(self):
+        stale = proposal_at(3)  # expected sequence is 6
+        msgs = [
+            vd(last_seq=5, in_flight=stale, prepared=True),
+            vd(last_seq=5),
+            vd(last_seq=5),
+        ]
+        ok, none_in_flight, _ = check_in_flight(msgs, F, QUORUM)
+        assert ok and none_in_flight
+
+    def test_undecided_when_prepared_but_quorum_contradicts(self):
+        # Two different prepared proposals at the expected sequence: each has
+        # f+1 prepared witnesses? No -- one each, so neither satisfies A2,
+        # and only 1 message says no-in-flight, so B fails too.
+        p1 = proposal_at(6, payload=b"a")
+        p2 = proposal_at(6, payload=b"b")
+        msgs = [
+            vd(last_seq=5, in_flight=p1, prepared=True),
+            vd(last_seq=5, in_flight=p2, prepared=True),
+            vd(last_seq=5),
+        ]
+        ok, _, _ = check_in_flight(msgs, F, QUORUM)
+        assert not ok
+
+    def test_expected_sequence_uses_max_last_decision(self):
+        # One reporter is a decision ahead: expected in-flight seq follows
+        # *its* last decision.
+        p = proposal_at(7)
+        msgs = [
+            vd(last_seq=6, in_flight=p, prepared=True),
+            vd(last_seq=6, in_flight=p, prepared=True),
+            vd(last_seq=5),
+        ]
+        ok, none_in_flight, proposal = check_in_flight(msgs, F, QUORUM)
+        assert ok and not none_in_flight and proposal == p
+
+
+class BatchVerifier:
+    """Counts batch calls; accepts sigs whose value matches 'sig-<id>'."""
+
+    def __init__(self):
+        self.batch_calls = 0
+
+    def verify_consenter_sigs_batch(self, signatures, proposal):
+        self.batch_calls += 1
+        return [
+            sig.msg if sig.value == b"sig-%d" % sig.id else None
+            for sig in signatures
+        ]
+
+
+class TestValidateLastDecision:
+    def sigs(self, ids):
+        return tuple(Signature(id=i, value=b"sig-%d" % i) for i in ids)
+
+    def test_genesis_passes_without_signatures(self):
+        data = ViewData(next_view=1, last_decision=Proposal())
+        assert validate_last_decision(data, QUORUM, BatchVerifier()) == 0
+
+    def test_quorum_of_valid_signatures_passes_in_one_batch(self):
+        v = BatchVerifier()
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(5),
+            last_decision_signatures=self.sigs([1, 2, 3]),
+        )
+        assert validate_last_decision(data, QUORUM, v) == 5
+        assert v.batch_calls == 1
+
+    def test_too_few_signatures_rejected(self):
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(5),
+            last_decision_signatures=self.sigs([1, 2]),
+        )
+        with pytest.raises(ValueError):
+            validate_last_decision(data, QUORUM, BatchVerifier())
+
+    def test_duplicate_signers_dont_count_twice(self):
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(5),
+            last_decision_signatures=self.sigs([1, 2]) + self.sigs([2]),
+        )
+        with pytest.raises(ValueError):
+            validate_last_decision(data, QUORUM, BatchVerifier())
+
+    def test_forged_signature_rejected(self):
+        sigs = self.sigs([1, 2]) + (Signature(id=3, value=b"forged"),)
+        data = ViewData(
+            next_view=1, last_decision=proposal_at(5), last_decision_signatures=sigs
+        )
+        with pytest.raises(ValueError):
+            validate_last_decision(data, QUORUM, BatchVerifier())
+
+    def test_decision_from_future_view_rejected(self):
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(5, view=1),
+            last_decision_signatures=self.sigs([1, 2, 3]),
+        )
+        with pytest.raises(ValueError):
+            validate_last_decision(data, QUORUM, BatchVerifier())
+
+
+class TestValidateInFlight:
+    def test_none_ok(self):
+        validate_in_flight(None, 5)
+
+    def test_sequence_must_follow_last_decision(self):
+        validate_in_flight(proposal_at(6), 5)
+        with pytest.raises(ValueError):
+            validate_in_flight(proposal_at(7), 5)
+        with pytest.raises(ValueError):
+            validate_in_flight(Proposal(payload=b"no-md"), 5)
+
+
+# --- full-cluster failure scenarios ---------------------------------------
+
+
+def test_leader_crash_triggers_view_change_and_ordering_resumes():
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    # Kill the leader of view 0 (node 1).
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 1))
+    # forward (1s) -> complain (4s) -> view change -> new leader orders.
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=600.0), (
+        "view change did not restore ordering"
+    )
+    cluster.assert_ledgers_consistent()
+    for node_id in (2, 3, 4):
+        assert cluster.nodes[node_id].consensus.controller.curr_view_number >= 1
+
+
+def test_view_change_commits_in_flight_proposal():
+    # Stage: all commits are dropped, so every replica reaches PREPARED but
+    # nobody decides. Then the leader dies. The view change must agree on
+    # the in-flight proposal (condition A) and re-commit it in the new view.
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.network.lose_messages = lambda target, sender, msg: isinstance(msg, Commit)
+    cluster.submit_to_all(make_request("c", 0))
+
+    def all_prepared():
+        from consensus_tpu.core.view import Phase
+
+        count = 0
+        for node in cluster.nodes.values():
+            c = node.consensus.controller
+            if c.curr_view is not None and c.curr_view.phase == Phase.PREPARED:
+                count += 1
+        return count >= 3
+
+    assert cluster.scheduler.run_until(all_prepared, max_time=60.0)
+    assert all(len(n.app.ledger) == 0 for n in cluster.nodes.values())
+
+    cluster.nodes[1].crash()
+    cluster.network.lose_messages = None  # commits flow again
+
+    assert cluster.run_until_ledger(1, node_ids=[2, 3, 4], max_time=600.0), (
+        "in-flight proposal was not committed by the view change"
+    )
+    cluster.assert_ledgers_consistent()
+    # The committed decision is the original in-flight proposal.
+    from consensus_tpu.testing.app import unpack_batch
+
+    for node_id in (2, 3, 4):
+        ledger = cluster.nodes[node_id].app.ledger
+        assert len(ledger) >= 1
+        assert make_request("c", 0) in unpack_batch(ledger[0].proposal.payload)
+
+
+def test_ordering_continues_after_two_successive_leader_crashes():
+    cluster = Cluster(7, config_tweaks=FAST)  # f=2: tolerate two crashes
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 1))
+    alive = [2, 3, 4, 5, 6, 7]
+    assert cluster.run_until_ledger(2, node_ids=alive, max_time=600.0)
+
+    cluster.nodes[2].crash()
+    cluster.submit_to_all(make_request("c", 2))
+    alive = [3, 4, 5, 6, 7]
+    assert cluster.run_until_ledger(3, node_ids=alive, max_time=900.0)
+    cluster.assert_ledgers_consistent()
+
+
+def test_heartbeat_timeout_triggers_view_change_without_requests():
+    # No client traffic at all: a silent leader must still be deposed via
+    # the heartbeat path.
+    cluster = Cluster(4, config_tweaks=dict(FAST, leader_heartbeat_timeout=8.0))
+    cluster.start()
+    # Let the cluster settle, then kill the leader.
+    cluster.scheduler.advance(2.0)
+    cluster.nodes[1].crash()
+    ok = cluster.scheduler.run_until(
+        lambda: all(
+            cluster.nodes[i].consensus.controller.curr_view_number >= 1
+            for i in (2, 3, 4)
+        ),
+        max_time=600.0,
+    )
+    assert ok, "heartbeat timeout did not depose the silent leader"
+    # And the new view still orders requests.
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, node_ids=[2, 3, 4], max_time=300.0)
+
+
+# --- view-changer unit harness (crash-restore path) ------------------------
+
+
+class _VCStubController:
+    def __init__(self):
+        self.aborted = []
+        self.changed = []
+        self.synced = 0
+
+    def abort_view(self, view):
+        self.aborted.append(view)
+
+    def view_changed(self, view, seq):
+        self.changed.append((view, seq))
+
+    def sync(self):
+        self.synced += 1
+
+    def deliver(self, proposal, signatures):
+        from consensus_tpu.types import Reconfig
+
+        return Reconfig()
+
+    def maybe_prune_revoked_requests(self):
+        pass
+
+
+class _VCStubTimer:
+    def __init__(self):
+        self.stopped = 0
+        self.restarted = 0
+
+    def stop_timers(self):
+        self.stopped += 1
+
+    def restart_timers(self):
+        self.restarted += 1
+
+    def remove_request(self, info):
+        return True
+
+
+class _VCComm:
+    def __init__(self):
+        self.broadcasts = []
+        self.sent = []
+
+    def broadcast(self, msg):
+        self.broadcasts.append(msg)
+
+    def send(self, target, msg):
+        self.sent.append((target, msg))
+
+
+def _make_vc(view=0):
+    from consensus_tpu.core.state import InFlightData, PersistedState
+    from consensus_tpu.core.viewchanger import ViewChanger
+    from consensus_tpu.runtime import SimScheduler
+    from consensus_tpu.testing import MemWAL
+    from consensus_tpu.types import Checkpoint
+
+    class TrivialSigner:
+        def sign(self, data):
+            return b"sig-2"
+
+        def sign_proposal(self, proposal, aux=b""):
+            return Signature(id=2, value=b"sig-2", msg=aux)
+
+    sched = SimScheduler()
+    comm = _VCComm()
+    controller = _VCStubController()
+    timer = _VCStubTimer()
+    in_flight = InFlightData()
+    state = PersistedState(MemWAL([]), in_flight, entries=[])
+    vc = ViewChanger(
+        scheduler=sched,
+        self_id=2,
+        n=4,
+        nodes=(1, 2, 3, 4),
+        comm=comm,
+        signer=TrivialSigner(),
+        verifier=BatchVerifier2(),
+        checkpoint=Checkpoint(),
+        in_flight=in_flight,
+        state=state,
+        controller=controller,
+        requests_timer=timer,
+        synchronizer=controller,
+        application=controller,
+        leader_rotation=False,
+        decisions_per_leader=0,
+    )
+    return vc, sched, comm, controller, timer
+
+
+class BatchVerifier2(BatchVerifier):
+    def verify_signature(self, signature):
+        if signature.value != b"sig-%d" % signature.id:
+            raise ValueError("bad")
+
+    def requests_from_proposal(self, proposal):
+        return []
+
+
+def test_restore_pending_view_change_rejoins_and_rearms():
+    # A replica that crashed after persisting its ViewChange vote must, on
+    # restart, re-broadcast the vote, arm the timeout, and send ViewData to
+    # the next leader (reference: the Restore channel + '|| restore' join).
+    from consensus_tpu.wire import SignedViewData as SVD, ViewChange as VC
+
+    vc, sched, comm, controller, timer = _make_vc()
+    vc.start(0, restore_view_change=VC(next_view=0))
+    sched.advance(0.5)  # run the posted restore event; stay below timeouts
+
+    vc_msgs = [m for m in comm.broadcasts if isinstance(m, VC)]
+    assert vc_msgs and vc_msgs[0].next_view == 1, "must re-broadcast the vote"
+    assert vc._check_timeout, "view-change timeout must be armed"
+    assert vc.curr_view == 1
+    # ViewData went to the next leader (node 2 = ourselves? leader of view 1
+    # without rotation is nodes[1 % 4] = 2) -- we ARE the next leader, so the
+    # vote is registered locally instead of sent.
+    assert vc._view_data_votes.get(2) is not None
+    vc.stop()
+
+
+def test_restore_resend_fires_until_quorum():
+    from consensus_tpu.wire import ViewChange as VC
+
+    vc, sched, comm, controller, timer = _make_vc()
+    vc.start(0, restore_view_change=VC(next_view=0))
+    sched.run_until(lambda: False, max_time=11.0)  # let resend ticks fire
+    vc_msgs = [m for m in comm.broadcasts if isinstance(m, VC)]
+    assert len(vc_msgs) >= 2, "vote must be re-broadcast on the resend timer"
+    vc.stop()
