@@ -1,0 +1,101 @@
+"""Benchmark: TPU-batched Ed25519 verification vs the sequential host path.
+
+This is the framework's headline number (BASELINE.md north star): the
+reference verifies each commit signature sequentially on CPU inside its own
+goroutine (reference internal/bft/view.go:537-541); this framework drains
+whole quorums/request batches into one device kernel.
+
+Prints ONE JSON line:
+    {"metric": "ed25519_verify_throughput", "value": <sigs/sec on device>,
+     "unit": "sigs/sec", "vs_baseline": <device/host speedup>}
+
+The device number includes host-side preparation (parse + SHA-512 + limb
+packing) — it is the end-to-end batch path a replica actually experiences.
+The baseline is the same batch verified one by one with the ``cryptography``
+package (OpenSSL), the fastest practical sequential-CPU equivalent of the
+reference's per-signature path.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BATCH = 8192
+DEVICE_ITERS = 5
+HOST_SAMPLE = 512
+
+
+def make_signatures(n: int):
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    msgs, sigs, keys = [], [], []
+    # A handful of distinct signers (a BFT cluster), many messages each.
+    signers = []
+    for i in range(16):
+        sk = Ed25519PrivateKey.from_private_bytes(bytes([i + 1] * 32))
+        pk = sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        signers.append((sk, pk))
+    for i in range(n):
+        sk, pk = signers[i % len(signers)]
+        m = b"request-%d" % i
+        msgs.append(m)
+        sigs.append(sk.sign(m))
+        keys.append(pk)
+    return msgs, sigs, keys
+
+
+def bench_device(msgs, sigs, keys) -> float:
+    from consensus_tpu.models import Ed25519BatchVerifier
+
+    verifier = Ed25519BatchVerifier()
+    ok = verifier.verify_batch(msgs, sigs, keys)  # warmup: compiles the kernel
+    assert ok.all(), "benchmark signatures must verify"
+    start = time.perf_counter()
+    for _ in range(DEVICE_ITERS):
+        verifier.verify_batch(msgs, sigs, keys)
+    elapsed = time.perf_counter() - start
+    return len(msgs) * DEVICE_ITERS / elapsed
+
+
+def bench_host(msgs, sigs, keys) -> float:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
+
+    n = min(HOST_SAMPLE, len(msgs))
+    start = time.perf_counter()
+    for i in range(n):
+        Ed25519PublicKey.from_public_bytes(keys[i]).verify(sigs[i], msgs[i])
+    elapsed = time.perf_counter() - start
+    return n / elapsed
+
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    msgs, sigs, keys = make_signatures(BATCH)
+    device_rate = bench_device(msgs, sigs, keys)
+    host_rate = bench_host(msgs, sigs, keys)
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verify_throughput",
+                "value": round(device_rate, 1),
+                "unit": "sigs/sec",
+                "vs_baseline": round(device_rate / host_rate, 3),
+            }
+        )
+    )
+    print(
+        f"# backend={backend} batch={BATCH} device={device_rate:.0f}/s "
+        f"host-sequential={host_rate:.0f}/s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,7 @@ from consensus_tpu.core.heartbeat import HeartbeatMonitor
 from consensus_tpu.core.pool import PoolOptions, RequestPool
 from consensus_tpu.core.state import InFlightData, PersistedState, ProposalMaker
 from consensus_tpu.core.view import View
+from consensus_tpu.metrics import Metrics
 from consensus_tpu.runtime.scheduler import Scheduler
 from consensus_tpu.types import Checkpoint, Proposal, Reconfig, Signature
 from consensus_tpu.wire import ConsensusMessage, ViewMetadata, decode_view_metadata
@@ -63,6 +64,7 @@ class Consensus:
         last_proposal: Optional[Proposal] = None,
         last_signatures: Sequence[Signature] = (),
         membership_notifier: Optional[MembershipNotifier] = None,
+        metrics: Optional[Metrics] = None,
     ) -> None:
         self.config = config
         self.scheduler = scheduler
@@ -78,6 +80,7 @@ class Consensus:
         self.last_proposal = last_proposal or Proposal()
         self.last_signatures = tuple(last_signatures)
         self.membership_notifier = membership_notifier
+        self.metrics = metrics or Metrics()
 
         self.nodes: tuple[int, ...] = ()
         self.controller: Optional[Controller] = None
@@ -181,6 +184,7 @@ class Consensus:
             proposer_builder=None,
             view_changer=None,
             on_reconfig=self._on_reconfig,
+            metrics=self.metrics,
         )
         self.controller = controller
 
@@ -205,6 +209,7 @@ class Consensus:
                 pool_options,
                 timeout_handler=controller,
                 on_submitted=self._on_pool_submitted,
+                metrics=self.metrics.request_pool,
             )
         self.pool = pool
         batcher = Batcher(
@@ -266,6 +271,7 @@ class Consensus:
             leader_rotation=cfg.leader_rotation,
             decisions_per_leader=cfg.decisions_per_leader,
             on_reconfig=self._on_reconfig,
+            metrics=self.metrics.view_change,
         )
         self.controller.view_changer = self.view_changer
 
@@ -297,6 +303,7 @@ class Consensus:
                 self.config.decisions_per_leader if self.config.leader_rotation else 0
             ),
             membership_notifier=self.membership_notifier,
+            metrics=self.metrics.view,
         )
 
     def _start_components(self, view: int, seq: int, dec: int) -> None:

@@ -38,6 +38,7 @@ from consensus_tpu.core.heartbeat import HeartbeatMonitor, Role
 from consensus_tpu.core.pool import RequestPool
 from consensus_tpu.core.state import InFlightData, PersistedState, ProposalMaker
 from consensus_tpu.core.view import Phase, View
+from consensus_tpu.metrics import Metrics
 from consensus_tpu.runtime.scheduler import Scheduler
 from consensus_tpu.types import Checkpoint, Proposal, Reconfig, RequestInfo, Signature
 from consensus_tpu.utils.leader import get_leader_id
@@ -100,6 +101,7 @@ class Controller:
         proposer_builder: ProposalMaker,
         view_changer: Optional[ViewChangerPort] = None,
         on_reconfig: Optional[Callable[[Reconfig], None]] = None,
+        metrics: Optional[Metrics] = None,
     ) -> None:
         self._sched = scheduler
         self._config = config
@@ -123,6 +125,7 @@ class Controller:
         self._proposer_builder = proposer_builder
         self.view_changer = view_changer
         self._on_reconfig = on_reconfig
+        self.metrics = metrics or Metrics()
 
         self.curr_view_number = 0
         self.curr_decisions_in_view = 0
@@ -418,11 +421,16 @@ class Controller:
 
         if reconfig.in_latest_decision:
             logger.info("%d: decision carried a reconfiguration", self.id)
+            self.metrics.consensus.count_consensus_reconfig.add(1)
             if self._on_reconfig is not None:
                 self._on_reconfig(reconfig)
             return
 
         md = decode_view_metadata(proposal.metadata)
+        self.metrics.blacklist.count.set(len(md.black_list))
+        self.metrics.blacklist.node_id_in_blacklist.set(
+            1 if self.id in md.black_list else 0
+        )
         if self._check_if_rotate(md.black_list):
             logger.info("%d: rotating leader after seq %d", self.id, md.latest_sequence)
             self.change_view(
@@ -452,7 +460,9 @@ class Controller:
                     response.latest.proposal, response.latest.signatures
                 )
             return response.reconfig
+        begin = self._sched.now()
         reconfig = self._application.deliver(proposal, signatures)
+        self.metrics.view.latency_batch_save.observe(self._sched.now() - begin)
         self.checkpoint.set(proposal, signatures)
         return reconfig
 
@@ -522,6 +532,7 @@ class Controller:
         if self._sync_in_progress:
             return
         self._sync_in_progress = True
+        sync_begin = self._sched.now()
 
         response = self._synchronizer.sync()
         if response.reconfig.in_latest_decision:
@@ -555,6 +566,7 @@ class Controller:
         def on_state(result: Optional[tuple[int, int]]) -> None:
             nonlocal new_view, new_decisions
             self._sync_in_progress = False
+            self.metrics.consensus.latency_sync.observe(self._sched.now() - sync_begin)
             latest_decision_seq = (
                 latest_md.latest_sequence if latest_md is not None else 0
             )

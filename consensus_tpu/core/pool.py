@@ -29,6 +29,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Protocol, Sequence
 
 from consensus_tpu.api.deps import RequestInspector
+from consensus_tpu.metrics import MetricsRequestPool, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
 from consensus_tpu.types import RequestInfo
 
@@ -98,6 +99,7 @@ class RequestPool:
         *,
         timeout_handler: Optional[RequestTimeoutHandler] = None,
         on_submitted: Optional[Callable[[], None]] = None,
+        metrics: Optional[MetricsRequestPool] = None,
     ) -> None:
         self._sched = scheduler
         self._inspector = inspector
@@ -114,6 +116,7 @@ class RequestPool:
         self._deleted: "OrderedDict[str, float]" = OrderedDict()
         self._timers_stopped = False
         self._closed = False
+        self._metrics = metrics or MetricsRequestPool(NoopProvider())
 
     # --- admission ---------------------------------------------------------
 
@@ -127,6 +130,8 @@ class RequestPool:
         """
 
         def done(err: Optional[str]) -> None:
+            if err is not None:
+                self._metrics.count_of_fail_add_request.add(1)
             if on_done is not None:
                 on_done(err)
 
@@ -172,6 +177,8 @@ class RequestPool:
         entry = _Entry(raw, info, self._sched.now())
         self._fifo[info.key()] = entry
         self._bytes += len(raw)
+        self._metrics.count_of_elements.set(len(self._fifo))
+        self._metrics.count_of_elements_all.add(1)
         if not self._timers_stopped:
             self._arm_stage(entry, 0)
         if self._on_submitted is not None:
@@ -209,10 +216,12 @@ class RequestPool:
             return
         if entry.stage == 0:
             logger.debug("request %s forward timeout", entry.info)
+            self._metrics.count_leader_forward_request.add(1)
             if self._handler is not None:
                 self._handler.on_request_timeout(entry.raw, entry.info)
             self._arm_stage(entry, 1)
         elif entry.stage == 1:
+            self._metrics.count_timeout_two_step.add(1)
             logger.warning("request %s leader-forward timeout: complaining", entry.info)
             if self._handler is not None:
                 self._handler.on_leader_fwd_request_timeout(entry.raw, entry.info)
@@ -278,6 +287,9 @@ class RequestPool:
         if entry.timer is not None:
             entry.timer.cancel()
         self._bytes -= len(entry.raw)
+        self._metrics.count_of_delete_request.add(1)
+        self._metrics.count_of_elements.set(len(self._fifo))
+        self._metrics.latency_of_elements.observe(self._sched.now() - entry.arrived_at)
         self._deleted[key] = self._sched.now()
         self._gc_deleted()
         self._drain_parked()

@@ -28,6 +28,7 @@ from enum import IntEnum
 from typing import Callable, Optional, Protocol, Sequence
 
 from consensus_tpu.api.deps import MembershipNotifier, Signer, Verifier
+from consensus_tpu.metrics import MetricsView, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler
 from consensus_tpu.types import Proposal, RequestInfo, Signature
 from consensus_tpu.utils.digests import commit_signatures_digest
@@ -128,6 +129,7 @@ class View:
         decisions_per_leader: int = 0,
         membership_notifier: Optional[MembershipNotifier] = None,
         blacklist_supported: bool = False,
+        metrics: Optional[MetricsView] = None,
     ) -> None:
         self._sched = scheduler
         self.self_id = self_id
@@ -178,6 +180,12 @@ class View:
         self._last_voted_proposal_by_id: dict[int, Commit] = {}
 
         self.stopped = False
+        self._begin_pre_prepare = 0.0
+        self.metrics = metrics or MetricsView(NoopProvider())
+        self.metrics.view_number.set(number)
+        self.metrics.leader_id.set(leader_id)
+        self.metrics.proposal_sequence.set(proposal_sequence)
+        self.metrics.decisions_in_view.set(decisions_in_view)
 
     # ------------------------------------------------------------------ API
 
@@ -185,6 +193,11 @@ class View:
         """Kick a (possibly WAL-restored) view into action: re-broadcast the
         message implied by the restored phase (reference resurrects
         ``lastBroadcastSent``, internal/bft/state.go:163-247)."""
+        if self.phase != Phase.COMMITTED and self._begin_pre_prepare == 0.0:
+            # Restored mid-protocol: latency measures from the restart, not
+            # from clock epoch.
+            self._begin_pre_prepare = self._sched.now()
+        self.metrics.phase.set(int(self.phase))
         if self.phase == Phase.PROPOSED and self._curr_prepare_sent is not None:
             self._comm.broadcast(self._curr_prepare_sent)
         elif self.phase == Phase.PREPARED and self._curr_commit_sent is not None:
@@ -208,6 +221,7 @@ class View:
         """Parity: reference view.go Abort/stop."""
         self.stopped = True
         self.phase = Phase.ABORT
+        self.metrics.phase.set(int(self.phase))
 
     @property
     def view_sequence(self) -> tuple[int, int]:
@@ -334,10 +348,13 @@ class View:
 
         self.in_flight_proposal = proposal
         self.in_flight_requests = tuple(requests)
+        self.metrics.count_txs_in_batch.set(len(requests))
+        self._begin_pre_prepare = self._sched.now()
         self._curr_prepare_sent = Prepare(
             view=prepare.view, seq=prepare.seq, digest=prepare.digest, assist=True
         )
         self.phase = Phase.PROPOSED
+        self.metrics.phase.set(int(self.phase))
 
         if self.self_id == self.leader_id:
             # Only now does the leader reveal the proposal to the others.
@@ -374,6 +391,7 @@ class View:
             assist=True,
         )
         self.phase = Phase.PREPARED
+        self.metrics.phase.set(int(self.phase))
         self._comm.broadcast(commit)
         logger.info("%d: prepared seq %d (%d prepares)", self.self_id, commit.seq, len(voters))
 
@@ -395,6 +413,14 @@ class View:
         logger.info(
             "%d: collected %d commits for seq %d",
             self.self_id, len(signatures), self.proposal_sequence,
+        )
+        self.metrics.count_batch_all.add(1)
+        self.metrics.count_txs_all.add(len(requests))
+        size = len(proposal.payload) + len(proposal.header) + len(proposal.metadata)
+        size += sum(len(s.value) + len(s.msg) for s in signatures)
+        self.metrics.size_of_batch.add(size)
+        self.metrics.latency_batch_processing.observe(
+            self._sched.now() - self._begin_pre_prepare
         )
         self._start_next_seq()
         self._decider.decide(proposal, signatures, requests)
@@ -419,6 +445,7 @@ class View:
             return  # not enough to possibly decide; keep buffering
 
         sigs = [c.signature for c in pending]
+        self.metrics.count_batch_sig_verifications.add(len(sigs))
         results = self._verifier.verify_consenter_sigs_batch(
             sigs, self.in_flight_proposal
         )
@@ -437,7 +464,10 @@ class View:
     def _start_next_seq(self) -> None:
         self.proposal_sequence += 1
         self.decisions_in_view += 1
+        self.metrics.proposal_sequence.set(self.proposal_sequence)
+        self.metrics.decisions_in_view.set(self.decisions_in_view)
         self.phase = Phase.COMMITTED
+        self.metrics.phase.set(int(self.phase))
         self.in_flight_proposal = None
         self.in_flight_requests = ()
         self.my_commit_signature = None

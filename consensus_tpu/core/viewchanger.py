@@ -39,6 +39,7 @@ from typing import Callable, Optional, Protocol, Sequence
 from consensus_tpu.api.deps import Signer, Verifier
 from consensus_tpu.core.state import InFlightData, PersistedState
 from consensus_tpu.core.view import Phase, View
+from consensus_tpu.metrics import MetricsViewChange, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
 from consensus_tpu.types import Checkpoint, Proposal, RequestInfo, Signature
 from consensus_tpu.utils.leader import get_leader_id
@@ -242,6 +243,7 @@ class ViewChanger:
         decisions_per_leader: int = 3,
         tick_period: float = 1.0,
         on_reconfig: Optional[Callable] = None,
+        metrics: Optional[MetricsViewChange] = None,
     ) -> None:
         self._sched = scheduler
         self.self_id = self_id
@@ -285,6 +287,7 @@ class ViewChanger:
 
         self._timer: Optional[TimerHandle] = None
         self._stopped = True
+        self.metrics = metrics or MetricsViewChange(NoopProvider())
 
     # ----------------------------------------------------------- lifecycle
 
@@ -294,6 +297,7 @@ class ViewChanger:
         self.curr_view = view
         self.real_view = view
         self.next_view = view
+        self._update_view_gauges()
         self._last_resend = self._sched.now()
         self._schedule_tick()
         if restore_view_change is not None:
@@ -356,6 +360,11 @@ class ViewChanger:
 
     # ------------------------------------------------------------ identity
 
+    def _update_view_gauges(self) -> None:
+        self.metrics.current_view.set(self.curr_view)
+        self.metrics.next_view.set(self.next_view)
+        self.metrics.real_view.set(self.real_view)
+
     def _get_leader(self) -> int:
         proposal, _ = self._checkpoint.get()
         blacklist: tuple[int, ...] = ()
@@ -391,6 +400,7 @@ class ViewChanger:
             self._check_timeout = True  # already changing; keep the clock on
             return
         self.next_view = self.curr_view + 1
+        self._update_view_gauges()
         self._requests_timer.stop_timers()
         self._comm.broadcast(ViewChange(next_view=self.next_view))
         logger.info(
@@ -410,6 +420,7 @@ class ViewChanger:
         self.curr_view = view
         self.real_view = view
         self.next_view = view
+        self._update_view_gauges()
         self._nvs.clear()
         self._view_change_votes = {}
         self._view_data_votes = {}
@@ -481,6 +492,7 @@ class ViewChanger:
             )
         self._controller.abort_view(self.curr_view)
         self.curr_view = self.next_view
+        self._update_view_gauges()
         self._view_change_votes = {}
         self._view_data_votes = {}
         svd = self._prepare_view_data()
@@ -788,6 +800,7 @@ class ViewChanger:
         if self._stopped:
             return
         self.real_view = self.curr_view
+        self._update_view_gauges()
         self._nvs.clear()
         self._controller.view_changed(self.curr_view, my_seq + 1)
         self._requests_timer.restart_timers()

@@ -1,0 +1,241 @@
+"""Metrics: provider abstraction, no-op and in-memory implementations, and
+the five instrument bundles the protocol reports into.
+
+Parity: reference pkg/metrics/provider.go:11-18 (Provider / Counter / Gauge /
+Histogram), pkg/metrics/disabled/provider.go (no-op), and
+pkg/api/metrics.go:70-578 (the 5 bundles / 28 instruments, same names).
+An embedder passes its own Provider (e.g. Prometheus-backed) to the facade;
+the default is no-op.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+
+class Counter(abc.ABC):
+    @abc.abstractmethod
+    def add(self, delta: float = 1.0) -> None: ...
+
+
+class Gauge(abc.ABC):
+    @abc.abstractmethod
+    def set(self, value: float) -> None: ...
+
+    @abc.abstractmethod
+    def add(self, delta: float = 1.0) -> None: ...
+
+
+class Histogram(abc.ABC):
+    @abc.abstractmethod
+    def observe(self, value: float) -> None: ...
+
+
+class Provider(abc.ABC):
+    """Parity: reference pkg/metrics/provider.go:11-18."""
+
+    @abc.abstractmethod
+    def new_counter(self, name: str, help: str = "") -> Counter: ...
+
+    @abc.abstractmethod
+    def new_gauge(self, name: str, help: str = "") -> Gauge: ...
+
+    @abc.abstractmethod
+    def new_histogram(self, name: str, help: str = "") -> Histogram: ...
+
+
+class _NoopInstrument(Counter, Gauge, Histogram):
+    def add(self, delta: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+
+class NoopProvider(Provider):
+    """Parity: reference pkg/metrics/disabled/provider.go:13-17."""
+
+    _instrument = _NoopInstrument()
+
+    def new_counter(self, name: str, help: str = "") -> Counter:
+        return self._instrument
+
+    def new_gauge(self, name: str, help: str = "") -> Gauge:
+        return self._instrument
+
+    def new_histogram(self, name: str, help: str = "") -> Histogram:
+        return self._instrument
+
+
+class _MemInstrument(Counter, Gauge, Histogram):
+    def __init__(self) -> None:
+        self.value = 0.0
+        self.observations: list[float] = []
+
+    def add(self, delta: float = 1.0) -> None:
+        self.value += delta
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+    def observe(self, value: float) -> None:
+        self.observations.append(value)
+
+
+class InMemoryProvider(Provider):
+    """Collects values in plain dicts — for tests and the bench harness."""
+
+    def __init__(self) -> None:
+        self.instruments: dict[str, _MemInstrument] = {}
+
+    def _get(self, name: str) -> _MemInstrument:
+        return self.instruments.setdefault(name, _MemInstrument())
+
+    def new_counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name)
+
+    def new_gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(name)
+
+    def new_histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(name)
+
+    def value(self, name: str) -> float:
+        # Strict read: a misspelled/unwired name fails instead of
+        # vacuously returning 0.
+        return self.instruments[name].value
+
+    def observations(self, name: str) -> list[float]:
+        return self.instruments[name].observations
+
+
+# --- instrument bundles (names mirror reference pkg/api/metrics.go) --------
+
+
+class MetricsRequestPool:
+    """Parity: reference pkg/api/metrics.go:172-237 (7 instruments)."""
+
+    def __init__(self, p: Provider) -> None:
+        self.count_of_elements = p.new_gauge(
+            "pool_count_of_elements", "Number of elements in the consensus request pool."
+        )
+        self.count_of_elements_all = p.new_counter(
+            "pool_count_of_elements_all", "Total amount of elements in the pool."
+        )
+        self.count_of_fail_add_request = p.new_counter(
+            "pool_count_of_fail_add_request", "Submissions the pool rejected."
+        )
+        self.count_of_delete_request = p.new_counter(
+            "pool_count_of_delete_request", "Elements removed from the pool."
+        )
+        self.count_leader_forward_request = p.new_counter(
+            "pool_count_leader_forward_request", "Requests forwarded to the leader."
+        )
+        self.count_timeout_two_step = p.new_counter(
+            "pool_count_timeout_two_step", "Complaint-stage timeouts."
+        )
+        self.latency_of_elements = p.new_histogram(
+            "pool_latency_of_elements", "Time requests spend in the pool."
+        )
+
+
+class MetricsBlacklist:
+    """Parity: reference pkg/api/metrics.go:258-297 (2 instruments)."""
+
+    def __init__(self, p: Provider) -> None:
+        self.count = p.new_gauge("blacklist_count", "Nodes in the blacklist.")
+        self.node_id_in_blacklist = p.new_gauge(
+            "node_id_in_blacklist", "Whether this node id is blacklisted."
+        )
+
+
+class MetricsConsensus:
+    """Parity: reference pkg/api/metrics.go:319-344 (2 instruments)."""
+
+    def __init__(self, p: Provider) -> None:
+        self.count_consensus_reconfig = p.new_counter(
+            "consensus_reconfig", "Reconfigurations applied."
+        )
+        self.latency_sync = p.new_histogram(
+            "consensus_latency_sync", "Duration of synchronization rounds."
+        )
+
+
+class MetricsView:
+    """Parity: reference pkg/api/metrics.go:448-518 (12 instruments)."""
+
+    def __init__(self, p: Provider) -> None:
+        self.view_number = p.new_gauge("view_number", "Current view number.")
+        self.leader_id = p.new_gauge("view_leader_id", "Current leader id.")
+        self.proposal_sequence = p.new_gauge(
+            "view_proposal_sequence", "In-progress proposal sequence."
+        )
+        self.decisions_in_view = p.new_gauge(
+            "view_decisions", "Decisions made in the current view."
+        )
+        self.phase = p.new_gauge("view_phase", "Current 3-phase state.")
+        self.count_txs_in_batch = p.new_gauge(
+            "view_count_txs_in_batch", "Transactions in the current batch."
+        )
+        self.count_batch_all = p.new_counter(
+            "view_count_batch_all", "Batches decided in total."
+        )
+        self.count_txs_all = p.new_counter(
+            "view_count_txs_all", "Transactions decided in total."
+        )
+        self.size_of_batch = p.new_counter("view_size_batch", "Decided bytes in total.")
+        self.latency_batch_processing = p.new_histogram(
+            "view_latency_batch_processing", "Pre-prepare to commit latency."
+        )
+        self.latency_batch_save = p.new_histogram(
+            "view_latency_batch_save", "Application delivery latency."
+        )
+        self.count_batch_sig_verifications = p.new_counter(
+            "view_count_batch_sig_verifications",
+            "Signature verifications drained into device batches "
+            "(consensus_tpu addition: the TPU offload volume).",
+        )
+
+
+class MetricsViewChange:
+    """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
+
+    def __init__(self, p: Provider) -> None:
+        self.current_view = p.new_gauge("viewchange_current_view", "View-changer current view.")
+        self.next_view = p.new_gauge("viewchange_next_view", "View being changed to.")
+        self.real_view = p.new_gauge("viewchange_real_view", "Last installed view.")
+
+
+class Metrics:
+    """The full bundle set handed through the facade.
+
+    Parity: reference pkg/api/metrics.go:70-104."""
+
+    def __init__(self, provider: Optional[Provider] = None) -> None:
+        provider = provider or NoopProvider()
+        self.provider = provider
+        self.request_pool = MetricsRequestPool(provider)
+        self.blacklist = MetricsBlacklist(provider)
+        self.consensus = MetricsConsensus(provider)
+        self.view = MetricsView(provider)
+        self.view_change = MetricsViewChange(provider)
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Provider",
+    "NoopProvider",
+    "InMemoryProvider",
+    "Metrics",
+    "MetricsRequestPool",
+    "MetricsBlacklist",
+    "MetricsConsensus",
+    "MetricsView",
+    "MetricsViewChange",
+]

@@ -1,0 +1,20 @@
+"""Batched signature-verification models built on :mod:`consensus_tpu.ops`."""
+
+from consensus_tpu.models.ed25519 import Ed25519BatchVerifier, L
+from consensus_tpu.models.engine import BatchCoalescer
+from consensus_tpu.models.verifier import (
+    Ed25519Signer,
+    Ed25519VerifierMixin,
+    commit_message,
+    raw_message,
+)
+
+__all__ = [
+    "Ed25519BatchVerifier",
+    "L",
+    "BatchCoalescer",
+    "Ed25519Signer",
+    "Ed25519VerifierMixin",
+    "commit_message",
+    "raw_message",
+]

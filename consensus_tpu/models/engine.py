@@ -1,0 +1,85 @@
+"""Micro-batching coalescer: merges verification work from many sources into
+single device launches.
+
+The View already batches per quorum (one ``verify_consenter_sigs_batch`` per
+decision), but a host running several replicas — or a replica pipelining
+decisions — produces many small batches in a short window.  The coalescer
+holds submissions for ``window`` seconds (or until ``max_batch`` items are
+pending) and flushes them as one kernel call, trading a bounded latency for
+multiplied arithmetic intensity.  The window must stay well under the
+network RTT to not hurt p50 commit latency (SURVEY §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
+
+
+class BatchCoalescer:
+    """Generic (items -> results) coalescer on the replica scheduler.
+
+    ``run_batch`` receives the concatenated items of all pending
+    submissions and must return one result per item, in order.
+    """
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        run_batch: Callable[[Sequence], Sequence],
+        *,
+        window: float = 0.002,
+        max_batch: int = 1024,
+    ) -> None:
+        self._sched = scheduler
+        self._run_batch = run_batch
+        self._window = window
+        self._max_batch = max_batch
+        self._pending: list[tuple[list, Callable[[Sequence], None]]] = []
+        self._pending_count = 0
+        self._timer: Optional[TimerHandle] = None
+
+    def submit(self, items: Sequence, on_results: Callable[[Sequence], None]) -> None:
+        """Queue ``items``; ``on_results`` fires with their results once the
+        batch they rode in completes."""
+        items = list(items)
+        if not items:
+            on_results([])
+            return
+        self._pending.append((items, on_results))
+        self._pending_count += len(items)
+        if self._pending_count >= self._max_batch:
+            self.flush()
+        elif self._timer is None:
+            self._timer = self._sched.call_later(
+                self._window, self.flush, name="crypto-batch-window"
+            )
+
+    def flush(self) -> None:
+        """Run everything pending as one batch."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        pending, self._pending, self._pending_count = self._pending, [], 0
+        if not pending:
+            return
+        merged: list = []
+        for items, _ in pending:
+            merged.extend(items)
+        results = self._run_batch(merged)
+        if len(results) != len(merged):
+            raise ValueError(
+                f"run_batch returned {len(results)} results for {len(merged)} items"
+            )
+        offset = 0
+        for items, on_results in pending:
+            on_results(results[offset : offset + len(items)])
+            offset += len(items)
+
+    @property
+    def pending_count(self) -> int:
+        return self._pending_count
+
+
+__all__ = ["BatchCoalescer"]

@@ -1,0 +1,139 @@
+"""Ed25519-backed implementations of the consensus crypto ports.
+
+The reference leaves ``Signer``/``Verifier`` entirely to the application
+(Fabric brings MSP crypto).  This module ships a ready-made Ed25519 identity
+layer whose *batch* verification paths run on the TPU engine
+(:class:`consensus_tpu.models.ed25519.Ed25519BatchVerifier`), so a consensus
+deployment gets the accelerated quorum verification without writing any
+crypto:
+
+* :class:`Ed25519Signer` — holds this replica's private key (host-side;
+  secrets never leave the host), signs raw payloads and proposals.
+* :class:`Ed25519VerifierMixin` — implements the four signature-verification
+  methods of the ``Verifier`` port against a node-id -> public-key registry,
+  draining ``verify_consenter_sigs_batch`` / ``verify_requests_batch``
+  into single device batches.  Applications mix it in and add their
+  proposal/request semantics (``verify_proposal``, ``requests_from_proposal``).
+
+Message binding: a consenter signature covers
+``b"ctpu/commit" + proposal-digest + len(aux) + aux``, so the signature
+commits to both the proposal content and the auxiliary prepare-vouch list
+(the blacklist redemption evidence, reference internal/bft/view.go:472-481).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Mapping, Optional, Sequence
+
+from consensus_tpu.api.deps import Signer, Verifier
+from consensus_tpu.models.ed25519 import Ed25519BatchVerifier
+from consensus_tpu.types import Proposal, RequestInfo, Signature
+
+_COMMIT_TAG = b"ctpu/commit"
+_RAW_TAG = b"ctpu/raw"
+
+
+def commit_message(proposal: Proposal, aux: bytes) -> bytes:
+    digest = bytes.fromhex(proposal.digest())
+    return _COMMIT_TAG + digest + struct.pack(">I", len(aux)) + aux
+
+
+def raw_message(data: bytes) -> bytes:
+    return _RAW_TAG + data
+
+
+class Ed25519Signer(Signer):
+    """This replica's signing identity (private key stays host-side)."""
+
+    def __init__(self, node_id: int, private_key_bytes: Optional[bytes] = None) -> None:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+
+        self.node_id = node_id
+        if private_key_bytes is None:
+            self._key = Ed25519PrivateKey.generate()
+        else:
+            self._key = Ed25519PrivateKey.from_private_bytes(private_key_bytes)
+        self.public_bytes = self._key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+
+    def sign(self, data: bytes) -> bytes:
+        return self._key.sign(raw_message(data))
+
+    def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature:
+        return Signature(
+            id=self.node_id,
+            value=self._key.sign(commit_message(proposal, aux)),
+            msg=aux,
+        )
+
+
+class Ed25519VerifierMixin(Verifier):
+    """Signature-verification half of the ``Verifier`` port, batched onto the
+    device.  Subclasses provide the application half (proposal/request checks).
+    """
+
+    def __init__(
+        self,
+        public_keys: Mapping[int, bytes],
+        *,
+        engine: Optional[Ed25519BatchVerifier] = None,
+    ) -> None:
+        self._public_keys = dict(public_keys)
+        self._engine = engine or Ed25519BatchVerifier()
+
+    def set_public_keys(self, public_keys: Mapping[int, bytes]) -> None:
+        """Swap the key registry (reconfiguration)."""
+        self._public_keys = dict(public_keys)
+
+    # --- single-signature paths (host) ----------------------------------
+
+    def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
+        result = self.verify_consenter_sigs_batch([signature], proposal)[0]
+        if result is None:
+            raise ValueError(f"invalid consenter signature from {signature.id}")
+        return result
+
+    def verify_signature(self, signature: Signature) -> None:
+        key = self._public_keys.get(signature.id)
+        if key is None:
+            raise ValueError(f"unknown signer {signature.id}")
+        ok = self._engine.verify_batch(
+            [raw_message(signature.msg)], [signature.value], [key]
+        )
+        if not ok[0]:
+            raise ValueError(f"invalid signature from {signature.id}")
+
+    # --- batch paths (device) --------------------------------------------
+
+    def verify_consenter_sigs_batch(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> list[Optional[bytes]]:
+        messages, sigs, keys = [], [], []
+        known: list[bool] = []
+        for sig in signatures:
+            key = self._public_keys.get(sig.id)
+            known.append(key is not None)
+            messages.append(commit_message(proposal, sig.msg))
+            sigs.append(sig.value)
+            keys.append(key if key is not None else b"\x00" * 32)
+        ok = self._engine.verify_batch(messages, sigs, keys)
+        return [
+            signatures[i].msg if (known[i] and ok[i]) else None
+            for i in range(len(signatures))
+        ]
+
+    def auxiliary_data(self, msg: bytes) -> bytes:
+        return msg
+
+
+__all__ = [
+    "Ed25519Signer",
+    "Ed25519VerifierMixin",
+    "commit_message",
+    "raw_message",
+]

@@ -1,0 +1,5 @@
+"""TPU numeric kernels: GF(2^255-19) limb arithmetic + edwards25519 group ops."""
+
+from consensus_tpu.ops import ed25519, field25519
+
+__all__ = ["field25519", "ed25519"]

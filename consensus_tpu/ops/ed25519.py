@@ -1,0 +1,256 @@
+"""edwards25519 group operations on batched limb vectors.
+
+Extended homogeneous coordinates (X : Y : Z : T) with x = X/Z, y = Y/Z,
+T = XY/Z on the a = -1 twisted Edwards curve -x^2 + y^2 = 1 + d x^2 y^2.
+Formulas: add-2008-hwcd-3 (8M) and dbl-2008-hwcd (4M + 4S) — complete for
+this curve, so a single code path covers identity/doubling/negatives and
+the double-scalar-mult scan needs no data-dependent branches (every step is
+double + two selected adds of constant shape, exactly what XLA wants).
+
+Point decompression (RFC 8032 §5.1.3) runs on-device too: the square root
+is a fixed-exponent ``pow_const`` chain, so a batch of compressed keys and
+R points decompresses in two scans — no per-element host math.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from consensus_tpu.ops import field25519 as fe
+
+# Base point of edwards25519 (RFC 8032).
+_BY = (4 * pow(5, fe.P - 2, fe.P)) % fe.P
+_BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+
+
+class Point(NamedTuple):
+    """Batched point in extended coordinates; each field is (20, *batch) int32."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+def identity(batch_shape) -> Point:
+    return Point(
+        x=fe.zeros_like_batch(batch_shape),
+        y=fe.from_int_broadcast(1, batch_shape),
+        z=fe.from_int_broadcast(1, batch_shape),
+        t=fe.zeros_like_batch(batch_shape),
+    )
+
+
+def base_point(batch_shape) -> Point:
+    return Point(
+        x=fe.from_int_broadcast(_BX, batch_shape),
+        y=fe.from_int_broadcast(_BY, batch_shape),
+        z=fe.from_int_broadcast(1, batch_shape),
+        t=fe.from_int_broadcast(_BX * _BY % fe.P, batch_shape),
+    )
+
+
+def identity_like(ref: jnp.ndarray) -> Point:
+    """Identity point inheriting ``ref``'s (20, *batch) shape *and* sharding
+    variance — required as a scan carry under ``shard_map`` (a broadcast
+    constant would be 'unvarying' and fail the carry type check)."""
+    return Point(
+        x=ref * 0,
+        y=fe.constant_like(1, ref),
+        z=fe.constant_like(1, ref),
+        t=ref * 0,
+    )
+
+
+def base_point_like(ref: jnp.ndarray) -> Point:
+    return Point(
+        x=fe.constant_like(_BX, ref),
+        y=fe.constant_like(_BY, ref),
+        z=fe.constant_like(1, ref),
+        t=fe.constant_like(_BX * _BY % fe.P, ref),
+    )
+
+
+def negate(p: Point) -> Point:
+    zero = p.x * 0
+    return Point(x=fe.sub(zero, p.x), y=p.y, z=p.z, t=fe.sub(zero, p.t))
+
+
+_D2 = fe.D2
+
+
+def add(p: Point, q: Point) -> Point:
+    """add-2008-hwcd-3: 8M + 1 constant mul."""
+    a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
+    b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
+    c = fe.mul(fe.mul(p.t, fe.constant_like(_D2, p.t)), q.t)
+    d = fe.mul(fe.add(p.z, p.z), q.z)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return Point(x=fe.mul(e, f), y=fe.mul(g, h), z=fe.mul(f, g), t=fe.mul(e, h))
+
+
+def double(p: Point, *, need_t: bool = True) -> Point:
+    """dbl-2008-hwcd: 4M + 4S (3M + 4S with ``need_t=False`` — the T input
+    is never read by doubling, so runs of doubles skip producing it)."""
+    a = fe.square(p.x)
+    b = fe.square(p.y)
+    c = fe.square(p.z)
+    c = fe.add(c, c)
+    h = fe.add(a, b)
+    xy = fe.add(p.x, p.y)
+    e = fe.sub(h, fe.square(xy))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    t = fe.mul(e, h) if need_t else p.t
+    return Point(x=fe.mul(e, f), y=fe.mul(g, h), z=fe.mul(f, g), t=t)
+
+
+def select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
+    """Per-element point select (cond shape = batch)."""
+    return Point(
+        x=fe.select(cond, p.x, q.x),
+        y=fe.select(cond, p.y, q.y),
+        z=fe.select(cond, p.z, q.z),
+        t=fe.select(cond, p.t, q.t),
+    )
+
+
+def conditional_add(p: Point, q: Point, bit: jnp.ndarray) -> Point:
+    """p + q where bit is set, else p — constant work either way."""
+    return select(bit == 1, add(p, q), p)
+
+
+def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
+    """Recover (x, y) from a compressed point's y limbs + x sign bit.
+
+    Returns (point with Z=1, valid mask).  RFC 8032 §5.1.3: x^2 = (y^2-1) /
+    (d y^2 + 1); candidate root x = u v^3 (u v^7)^((p-5)/8), fixed up by
+    sqrt(-1) when v x^2 == -u, rejected when neither matches.
+    """
+    one = fe.constant_like(1, y_limbs)
+    y2 = fe.square(y_limbs)
+    u = fe.sub(y2, one)
+    v = fe.add(fe.mul(fe.constant_like(fe.D, y_limbs), y2), one)
+
+    v3 = fe.mul(fe.square(v), v)
+    v7 = fe.mul(fe.square(v3), v)
+    x = fe.mul(fe.mul(u, v3), fe.pow_const(fe.mul(u, v7), (fe.P - 5) // 8))
+
+    vx2 = fe.mul(v, fe.square(x))
+    root_ok = fe.eq(vx2, u)
+    neg_u = fe.sub(u * 0, u)
+    root_neg = fe.eq(vx2, neg_u)
+    x_fixed = fe.mul(x, fe.constant_like(fe.SQRT_M1, y_limbs))
+    x = fe.select(root_neg, x_fixed, x)
+    valid = root_ok | root_neg
+
+    x_frozen = fe.freeze(x)
+    x_is_zero = jnp.all(x_frozen == 0, axis=0)
+    # x = 0 with sign bit set is invalid; u = 0 with x = 0 is the valid y=±1.
+    valid = valid & ~(x_is_zero & (sign == 1))
+    # Match the requested sign: x and p - x have opposite parities.
+    parity = x_frozen[0] & 1
+    x = fe.select((parity != sign) & ~x_is_zero, fe.sub(x * 0, x), x)
+
+    return Point(x=x, y=y_limbs, z=one, t=fe.mul(x, y_limbs)), valid
+
+
+def equal(p: Point, q: Point) -> jnp.ndarray:
+    """Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1."""
+    return fe.eq(fe.mul(p.x, q.z), fe.mul(q.x, p.z)) & fe.eq(
+        fe.mul(p.y, q.z), fe.mul(q.y, p.z)
+    )
+
+
+# --- windowed scalar-mult support -----------------------------------------
+
+
+def _edwards_add_int(p1, p2):
+    """Host-side integer point addition (affine) for constant-table gen."""
+    x1, y1 = p1
+    x2, y2 = p2
+    P_, D_ = fe.P, fe.D
+    denom_x = (1 + D_ * x1 * x2 * y1 * y2) % P_
+    denom_y = (1 - D_ * x1 * x2 * y1 * y2) % P_
+    x3 = (x1 * y2 + x2 * y1) * pow(denom_x, P_ - 2, P_) % P_
+    y3 = (y1 * y2 + x1 * x2) * pow(denom_y, P_ - 2, P_) % P_
+    return x3, y3
+
+
+def base_point_table_ints(size: int = 16) -> list[tuple[int, int]]:
+    """Affine (x, y) for j*B, j = 0..size-1 (identity first)."""
+    table = [(0, 1)]
+    for _ in range(size - 1):
+        table.append(_edwards_add_int(table[-1], (_BX, _BY)))
+    return table
+
+
+def table_lookup(table: Point, one_hot: jnp.ndarray) -> Point:
+    """Select table[digit] per batch element via a one-hot contraction —
+    pure VPU multiply-adds, no gather (TPU gathers serialize).
+
+    ``table`` coords are (W, 32, *batch) or (W, 32, 1); ``one_hot`` is
+    (W, *batch) float32."""
+    oh = one_hot[:, None]  # (W, 1, *batch)
+
+    def pick(coord: jnp.ndarray) -> jnp.ndarray:
+        return jnp.sum(coord * oh, axis=0)
+
+    return Point(x=pick(table.x), y=pick(table.y), z=pick(table.z), t=pick(table.t))
+
+
+def multiples_table(p: Point, size: int = 16) -> Point:
+    """j*p for j = 0..size-1, coords stacked on a leading axis (identity
+    first, so digit 0 adds the neutral element — the unified formulas make
+    that a plain add, no branch)."""
+    entries = [identity_like(p.x), p]
+    for _ in range(size - 2):
+        entries.append(add(entries[-1], p))
+    return Point(
+        x=jnp.stack([e.x for e in entries]),
+        y=jnp.stack([e.y for e in entries]),
+        z=jnp.stack([e.z for e in entries]),
+        t=jnp.stack([e.t for e in entries]),
+    )
+
+
+def base_table_like(ref: jnp.ndarray, size: int = 16) -> Point:
+    """The constant j*B table, shaped (size, 32, 1...) to broadcast against
+    ``ref``-shaped batches."""
+    ints = base_point_table_ints(size)
+    ones = (1,) * (ref.ndim - 1)
+
+    def coords(values):
+        arr = jnp.stack([jnp.asarray(fe.int_to_limbs(v)) for v in values])
+        return (ref[None, :] * 0) + arr.reshape(size, fe.LIMBS, *ones)
+
+    xs = coords([x for x, _ in ints])
+    ys = coords([y for _, y in ints])
+    zs = coords([1] * size)
+    ts = coords([(x * y) % fe.P for x, y in ints])
+    return Point(x=xs, y=ys, z=zs, t=ts)
+
+
+__all__ = [
+    "Point",
+    "identity",
+    "identity_like",
+    "base_point",
+    "base_point_like",
+    "negate",
+    "add",
+    "double",
+    "select",
+    "conditional_add",
+    "decompress",
+    "equal",
+    "base_point_table_ints",
+    "table_lookup",
+    "multiples_table",
+    "base_table_like",
+]

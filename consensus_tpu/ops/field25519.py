@@ -1,0 +1,272 @@
+"""GF(2^255-19) arithmetic as batched JAX float32 limb vectors.
+
+The building block of the TPU Ed25519 batch verifier
+(:mod:`consensus_tpu.models.ed25519`), which replaces the reference's
+goroutine-per-signature CPU verification (reference
+internal/bft/view.go:537-541) with one data-parallel kernel.
+
+Representation: a field element is **32 limbs x 8 bits** stored as
+``float32`` of shape ``(32, *batch)`` — limbs leading, batch trailing, so
+the batch axis rides the TPU's 128-wide vector lanes.  Why float32 with
+tiny limbs: the VPU has no native 32-bit integer multiply (int32 muls are
+emulated and ~10x slower), while f32 FMAs are native — and with 8-bit limbs
+every product is <= (255+85)^2 < 2^17 and every 32-term schoolbook column
+sums below 2^22, comfortably inside f32's 24-bit exact-integer window.  All
+arithmetic is therefore **bit-exact**; floats are used as fast small
+integers, never rounded.
+
+Multiplication is 32 broadcast-multiplies + shifted column adds (schoolbook
+convolution) followed by *parallel* carry-save passes (split with
+``floor(x/256)``, which is exact and floor-semantics for negatives, so
+borrows propagate like arithmetic shifts).  There are no sequential carry
+chains on the hot path.
+
+Normalization contract: public ops take and return *weakly reduced*
+elements — |limb| <= 340 with value within (-2^250, 2^255 + 2^13), exact
+mod p.  ``freeze`` (rare path: comparisons/parity) converts to int32 and
+produces the canonical representative in [0, p).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+LIMBS = 32
+LIMB_BITS = 8
+BASE = 256.0
+INV_BASE = 1.0 / 256.0
+
+P = 2**255 - 19
+#: 2^256 mod p — the weight of limb index 32 (used to fold product columns).
+FOLD = (2**256) % P  # == 38
+#: 2^255 mod p — the weight of bit 255 (used to fold limb 31's top bit).
+TOP_FOLD = 19
+#: d of edwards25519: -121665/121666 mod p.
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+#: sqrt(-1) mod p (for decompression's second root candidate).
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+
+def int_to_limbs(value: int) -> np.ndarray:
+    """Python int -> one limb vector (numpy, for constants and host prep)."""
+    if not 0 <= value < 2**256:
+        raise ValueError("value out of limb range")
+    return np.array(
+        [(value >> (LIMB_BITS * i)) & 0xFF for i in range(LIMBS)], dtype=np.float32
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    """Limb vector (limbs axis first) -> Python int (host-side)."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(LIMBS))
+
+
+def constant(value: int) -> jnp.ndarray:
+    return jnp.asarray(int_to_limbs(value % P))
+
+
+def _cexpand(const_limbs, like: jnp.ndarray) -> jnp.ndarray:
+    """Reshape a (32,) constant so it broadcasts against (32, *batch)."""
+    return jnp.reshape(jnp.asarray(const_limbs), (LIMBS,) + (1,) * (like.ndim - 1))
+
+
+def constant_like(value: int, like: jnp.ndarray) -> jnp.ndarray:
+    """A constant broadcast to ``like``'s shape, inheriting its sharding
+    variance (``like * 0 + c`` keeps shard_map's varying-axis typing)."""
+    return like * 0 + _cexpand(int_to_limbs(value % P), like)
+
+
+def from_int_broadcast(value: int, batch_shape) -> jnp.ndarray:
+    c = jnp.asarray(int_to_limbs(value % P)).reshape(
+        (LIMBS,) + (1,) * len(tuple(batch_shape))
+    )
+    return jnp.broadcast_to(c, (LIMBS, *batch_shape)).astype(jnp.float32)
+
+
+def zeros_like_batch(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((LIMBS, *batch_shape), dtype=jnp.float32)
+
+
+# --- reduction ------------------------------------------------------------
+
+
+def _split(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """x -> (x mod 256, floor(x / 256)); exact for |x| < 2^24, floor
+    semantics so negative limbs borrow correctly."""
+    hi = jnp.floor(x * INV_BASE)
+    return x - hi * BASE, hi
+
+
+def _relax(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry-save pass over 32 limbs: 13-bit-free split into an
+    8-bit residue plus a high part shifted one limb up; the top limb's high
+    part folds back at weight 2^256 ≡ 38.  No sequential dependency."""
+    lo, hi = _split(x)
+    rolled = jnp.concatenate([hi[31:] * FOLD, hi[:31]], axis=0)
+    return lo + rolled
+
+
+def _top_fold(x: jnp.ndarray) -> jnp.ndarray:
+    """Fold bit 255 (limb 31's bit >= 7) back at weight 19, bounding the
+    value below 2^255 + epsilon so subtraction biases stay in range."""
+    high = jnp.floor(x[31] * (1.0 / 128.0))
+    return jnp.concatenate(
+        [(x[0] + high * TOP_FOLD)[None], x[1:31], (x[31] - high * 128.0)[None]],
+        axis=0,
+    )
+
+
+def _weak_reduce(x: jnp.ndarray) -> jnp.ndarray:
+    """Parallel weak reduction for inputs with |limb| < 2^22: three relax
+    passes plus a top fold land limbs within |limb| <= 340."""
+    x = _relax(x)
+    x = _relax(x)
+    x = _relax(x)
+    return _top_fold(x)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _weak_reduce(a + b)
+
+
+#: 2p = 2^256 - 38 fits exactly in 32 limbs (top limb 255).
+_TWO_P = np.array(
+    [((2 * P) >> (LIMB_BITS * i)) & 0xFF for i in range(LIMBS)], dtype=np.float32
+)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # a + 2p - b stays positive for any weakly reduced a, b (< 2p each).
+    return _weak_reduce(a + _cexpand(_TWO_P, a) - b)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Batched field multiplication: schoolbook convolution as 32 broadcast
+    multiplies + shifted adds (full-lane VPU work), then parallel folds.
+
+    Weakly reduced inputs (|limb| <= 340) keep every column below
+    32 * 340^2 < 2^22 — exact in f32."""
+    batch_pad = [(0, 0)] * (a.ndim - 1)
+    terms = [
+        jnp.pad(a[i] * b, [(i, LIMBS - 1 - i)] + batch_pad) for i in range(LIMBS)
+    ]
+    cols = sum(terms)  # (63, *batch)
+    lo, hi = _split(cols)
+    c = jnp.concatenate([lo[:1], lo[1:] + hi[:-1], hi[-1:]], axis=0)  # width 64
+    r = c[:LIMBS] + c[LIMBS:] * FOLD  # |r| < 2^19
+    return _weak_reduce(r)
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+_P_LIMBS_I32 = np.array(
+    [(P >> (LIMB_BITS * i)) & 0xFF for i in range(LIMBS)], dtype=np.int32
+)
+_TWO_P_I32 = _TWO_P.astype(np.int32)
+
+
+def _carry_i32(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact sequential int32 carry pass (freeze-only path)."""
+    out = []
+    carry = jnp.zeros_like(x[0])
+    for k in range(LIMBS):
+        v = x[k] + carry
+        out.append(v & 0xFF)
+        carry = v >> LIMB_BITS
+    return jnp.stack(out, axis=0), carry
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical int32 representative in [0, p).
+
+    Weakly reduced values may be slightly negative (borrow limbs), so bias
+    by 2p first, normalize exactly, fold the top bit, then subtract p while
+    the value still exceeds it.  Rare path (comparisons/parity only)."""
+    x = jnp.asarray(jnp.rint(a), dtype=jnp.int32)
+    x = x + jnp.reshape(
+        jnp.asarray(_TWO_P_I32), (LIMBS,) + (1,) * (a.ndim - 1)
+    )
+    x, top = _carry_i32(x)  # value in (0, 2^256 + 2^255); top in {0, 1}
+    # Fold the carry-out (weight 2^256 ≡ 38) and bit 255 back.
+    x = x.at[0].add(top * FOLD)
+    high = x[31] >> 7
+    x = x.at[31].set(x[31] & 0x7F)
+    x = x.at[0].add(high * TOP_FOLD)
+    x, _ = _carry_i32(x)
+    p_e = jnp.reshape(jnp.asarray(_P_LIMBS_I32), (LIMBS,) + (1,) * (a.ndim - 1))
+    for _ in range(2):
+        d, borrow = _carry_i32(x - p_e)
+        ge_p = borrow == 0  # no negative carry out => x >= p
+        x = jnp.where(ge_p[None], d, x)
+    return x
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field equality (boolean per batch element)."""
+    return jnp.all(freeze(a) == freeze(b), axis=0)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-batch-element select between limb vectors (cond shape = batch)."""
+    return jnp.where(cond[None], a, b)
+
+
+def pow_const(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """x ** exponent for a fixed public exponent, via an MSB-first
+    square-and-multiply ``lax.scan`` (compiles to a rolled loop — the graph
+    stays small regardless of exponent length)."""
+    import jax
+
+    bits = [(exponent >> i) & 1 for i in range(exponent.bit_length())][::-1]
+    bits_arr = jnp.asarray(np.array(bits, dtype=np.int32))
+
+    def step(acc, bit):
+        acc = square(acc)
+        acc = select(bit == 1, mul(acc, x), acc)
+        return acc, None
+
+    # First bit is always 1: start from x to save one square+mul.
+    acc, _ = jax.lax.scan(step, x, bits_arr[1:])
+    return acc
+
+
+def invert(x: jnp.ndarray) -> jnp.ndarray:
+    """Field inverse via Fermat (x^(p-2)); x=0 maps to 0."""
+    return pow_const(x, P - 2)
+
+
+__all__ = [
+    "LIMBS",
+    "LIMB_BITS",
+    "P",
+    "D",
+    "D2",
+    "SQRT_M1",
+    "FOLD",
+    "int_to_limbs",
+    "limbs_to_int",
+    "constant",
+    "constant_like",
+    "from_int_broadcast",
+    "zeros_like_batch",
+    "add",
+    "sub",
+    "mul",
+    "square",
+    "freeze",
+    "eq",
+    "is_zero",
+    "select",
+    "pow_const",
+    "invert",
+]

@@ -1,0 +1,15 @@
+"""Device-mesh sharding of the crypto batch path (data parallel over ICI)."""
+
+from consensus_tpu.parallel.sharding import (
+    BATCH_AXIS,
+    ShardedEd25519Verifier,
+    make_mesh,
+    sharded_verify_fn,
+)
+
+__all__ = [
+    "BATCH_AXIS",
+    "make_mesh",
+    "sharded_verify_fn",
+    "ShardedEd25519Verifier",
+]

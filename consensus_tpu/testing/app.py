@@ -177,6 +177,8 @@ class Node:
         self.wal_backing: list[bytes] = []
         self.consensus: Optional[Consensus] = None
         self.running = False
+        #: Optional Metrics bundle handed to the next (re)build.
+        self.metrics = None
 
     def start(self) -> None:
         comm = self.cluster.network.register(self.node_id, self._on_message)
@@ -195,6 +197,7 @@ class Node:
             wal_initial_content=list(self.wal_backing),
             last_proposal=last.proposal if last else None,
             last_signatures=last.signatures if last else (),
+            metrics=self.metrics,
         )
         self.consensus.start()
         self.running = True

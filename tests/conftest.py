@@ -1,13 +1,21 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any ``import jax`` anywhere in the test session, hence the
-env mutation at conftest import time.  Bench runs (bench.py) use the real TPU
-instead; tests are CPU-deterministic.
+This interpreter pre-imports jax at startup (the TPU plugin's site hook), so
+env vars set here are too late for platform selection — but backends
+initialize lazily, so ``jax.config.update`` + an XLA_FLAGS mutation before
+first device use still route everything to 8 virtual CPU devices.  Bench
+runs (bench.py) use the real TPU; tests are CPU-deterministic.
 """
 
 import os
 
+# Harmless when jax is already imported; kept for subprocesses we spawn.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (already imported at startup; this is a no-op)
+
+jax.config.update("jax_platform_name", "cpu")

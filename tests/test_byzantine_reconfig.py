@@ -1,0 +1,173 @@
+"""Byzantine-fault and dynamic-reconfiguration scenarios.
+
+Parity model: reference test/basic_test.go (TestLeaderModifiesPreprepare:1134
+and partition scenarios) and test/reconfig_test.go (TestAddRemoveAddNodes:231).
+"""
+
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.types import Reconfig
+from consensus_tpu.wire import Commit, PrePrepare, Prepare
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 60.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+}
+
+
+def test_byzantine_leader_mutates_pre_prepare_gets_deposed():
+    # The leader sends a different proposal to each follower: digests can
+    # never match across prepares, no quorum forms, the complaint cascade
+    # deposes the leader, and the honest new leader orders the request.
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+
+    def mutate(sender, target, msg):
+        if sender == 1 and isinstance(msg, PrePrepare):
+            tampered = msg.proposal.__class__(
+                payload=msg.proposal.payload + b"|evil-for-%d" % target,
+                header=msg.proposal.header,
+                metadata=msg.proposal.metadata,
+                verification_sequence=msg.proposal.verification_sequence,
+            )
+            return PrePrepare(
+                view=msg.view, seq=msg.seq, proposal=tampered,
+                prev_commit_signatures=msg.prev_commit_signatures,
+            )
+        return msg
+
+    cluster.network.mutate_send = mutate
+    cluster.submit_to_all(make_request("c", 0))
+    # Nothing commits while the byzantine mutation is active (the followers
+    # prepare different digests).
+    cluster.scheduler.advance(3.0)
+    assert all(len(n.app.ledger) == 0 for n in cluster.nodes.values())
+
+    # The view change deposes node 1; the new leader is honest.
+    cluster.network.mutate_send = None
+    assert cluster.run_until_ledger(1, node_ids=[2, 3, 4], max_time=600.0)
+    cluster.assert_ledgers_consistent()
+    assert all(
+        cluster.nodes[i].consensus.controller.curr_view_number >= 1
+        for i in (2, 3, 4)
+    )
+
+
+def test_partitioned_minority_catches_up_after_heal():
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    # Partition node 4 away; the majority keeps ordering.
+    cluster.network.partition([4])
+    for i in range(1, 4):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, node_ids=[1, 2, 3], max_time=300.0)
+    assert len(cluster.nodes[4].app.ledger) == 1
+
+    # Heal: the straggler must catch up (censorship detection / heartbeat gap).
+    cluster.network.heal()
+    cluster.submit_to_all(make_request("c", 9))
+    assert cluster.run_until_ledger(5, node_ids=[1, 2, 3], max_time=300.0)
+    cluster.scheduler.advance(120.0)
+    assert len(cluster.nodes[4].app.ledger) >= 4
+    cluster.assert_ledgers_consistent()
+
+
+def test_lossy_network_still_orders():
+    # 20% loss on every link: retransmission help + timeouts must still
+    # drive the cluster to order (the protocol tolerates loss by contract).
+    cluster = Cluster(4, seed=3, config_tweaks=FAST)
+    cluster.start()
+    for a in range(1, 5):
+        for b in range(1, 5):
+            if a != b:
+                cluster.network.set_loss(a, b, 0.2)
+    for i in range(3):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, max_time=900.0), f"block {i} stalled"
+    cluster.assert_ledgers_consistent()
+
+
+# --- dynamic reconfiguration ------------------------------------------------
+
+
+def reconfig_request(rid, nodes):
+    payload = b"nodes=" + ",".join(str(n) for n in nodes).encode()
+    return make_request("admin", rid, payload)
+
+
+def install_reconfig_hook(cluster):
+    """Make the cluster's app report membership changes: a committed request
+    with payload ``nodes=...`` yields Reconfig(in_latest_decision=True)."""
+    from consensus_tpu.testing.app import unpack_batch
+
+    def reconfig_of(proposal):
+        try:
+            requests = unpack_batch(proposal.payload)
+        except Exception:
+            return Reconfig()
+        for raw in requests:
+            _, _, payload = raw.partition(b"|")
+            if payload.startswith(b"nodes="):
+                ids = tuple(int(x) for x in payload[6:].split(b","))
+                cluster.network.membership = list(ids)
+                return Reconfig(in_latest_decision=True, current_nodes=ids)
+        return Reconfig()
+
+    cluster.reconfig_of = reconfig_of
+
+
+def test_reconfig_removes_node_and_cluster_continues():
+    cluster = Cluster(5, config_tweaks=FAST)
+    install_reconfig_hook(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    # Commit a reconfiguration that evicts node 5.
+    cluster.submit_to_all(reconfig_request("rm5", [1, 2, 3, 4]))
+    assert cluster.run_until_ledger(2, node_ids=[1, 2, 3, 4], max_time=300.0)
+    cluster.scheduler.advance(30.0)
+
+    # The evicted node shut itself down.
+    assert cluster.nodes[5].consensus is None or not cluster.nodes[5].consensus._running
+
+    # The remaining 4 (quorum 3) keep ordering.
+    cluster.nodes[5].running = False  # exclude from ledger checks
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(3, node_ids=[1, 2, 3, 4], max_time=300.0)
+    cluster.assert_ledgers_consistent()
+
+
+def test_reconfig_adds_node_which_catches_up():
+    cluster = Cluster(4, config_tweaks=FAST)
+    install_reconfig_hook(cluster)
+    cluster.start()
+    for i in range(2):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1)
+
+    # Commit the add-node-5 reconfiguration.
+    cluster.submit_to_all(reconfig_request("add5", [1, 2, 3, 4, 5]))
+    assert cluster.run_until_ledger(3, node_ids=[1, 2, 3, 4], max_time=300.0)
+    cluster.scheduler.advance(5.0)
+
+    # Boot the new node; it must sync the existing ledger and participate.
+    from consensus_tpu.testing.app import Node
+    from consensus_tpu.config import Configuration
+
+    node5 = Node(5, cluster, Configuration(self_id=5, leader_rotation=False,
+                                           decisions_per_leader=0, **FAST))
+    cluster.nodes[5] = node5
+    node5.start()
+    cluster.scheduler.advance(120.0)  # heartbeat gap detection + sync
+
+    cluster.submit_to_all(make_request("c", 9))
+    assert cluster.run_until_ledger(4, node_ids=[1, 2, 3, 4], max_time=600.0)
+    cluster.scheduler.advance(120.0)
+    assert len(node5.app.ledger) >= 3, f"new node at {len(node5.app.ledger)}"
+    cluster.assert_ledgers_consistent()

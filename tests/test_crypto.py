@@ -1,0 +1,334 @@
+"""TPU crypto engine tests (run on the CPU JAX backend): field arithmetic
+against Python big-int, RFC 8032 vectors, batch verification against the
+``cryptography`` package, the Verifier-port adapter, and the coalescer.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from consensus_tpu.models import (
+    BatchCoalescer,
+    Ed25519BatchVerifier,
+    Ed25519Signer,
+    Ed25519VerifierMixin,
+)
+from consensus_tpu.ops import ed25519 as ed
+from consensus_tpu.ops import field25519 as fe
+from consensus_tpu.runtime import SimScheduler
+from consensus_tpu.types import Proposal, Signature
+
+
+def limbs_of(values):
+    # Device layout: limbs leading, batch trailing.
+    return jnp.asarray(np.stack([fe.int_to_limbs(v) for v in values], axis=1))
+
+
+def ints_of(arr):
+    frozen = np.asarray(fe.freeze(arr))
+    return [fe.limbs_to_int(frozen[:, i]) for i in range(frozen.shape[1])]
+
+
+class TestField:
+    def test_mul_add_sub_match_bigint(self):
+        rng = random.Random(7)
+        a_vals = [rng.randrange(fe.P) for _ in range(16)] + [0, 1, fe.P - 1, fe.P - 19]
+        b_vals = [rng.randrange(fe.P) for _ in range(16)] + [fe.P - 1, 0, fe.P - 1, 2]
+        a, b = limbs_of(a_vals), limbs_of(b_vals)
+        assert ints_of(fe.mul(a, b)) == [(x * y) % fe.P for x, y in zip(a_vals, b_vals)]
+        assert ints_of(fe.add(a, b)) == [(x + y) % fe.P for x, y in zip(a_vals, b_vals)]
+        assert ints_of(fe.sub(a, b)) == [(x - y) % fe.P for x, y in zip(a_vals, b_vals)]
+
+    def test_deep_mul_chain_stays_exact(self):
+        # Repeated squaring: any normalization bug compounds and is caught.
+        rng = random.Random(9)
+        vals = [rng.randrange(fe.P) for _ in range(4)]
+        x = limbs_of(vals)
+        want = vals
+        for _ in range(50):
+            x = fe.mul(x, x)
+            want = [w * w % fe.P for w in want]
+        assert ints_of(x) == want
+
+    def test_mixed_op_chains_with_borrows(self):
+        # Long random add/sub/mul chains: exercises the negative-limb
+        # (borrow) representations the parallel relaxed carries produce.
+        rng = random.Random(11)
+        vals = [rng.randrange(fe.P) for _ in range(8)]
+        other = [rng.randrange(fe.P) for _ in range(8)]
+        x, y = limbs_of(vals), limbs_of(other)
+        wx, wy = list(vals), list(other)
+        for step in range(60):
+            op = step % 3
+            if op == 0:
+                x = fe.sub(x, y)
+                wx = [(a - b) % fe.P for a, b in zip(wx, wy)]
+            elif op == 1:
+                x = fe.mul(x, y)
+                wx = [(a * b) % fe.P for a, b in zip(wx, wy)]
+            else:
+                y = fe.sub(y, x)
+                wy = [(b - a) % fe.P for a, b in zip(wx, wy)]
+        assert ints_of(x) == wx and ints_of(y) == wy
+
+    def test_freeze_handles_borrowed_negatives(self):
+        # sub(0, small) yields a weakly-reduced value with negative limbs;
+        # freeze must still canonicalize it.
+        zero = limbs_of([0, 0, 0])
+        small = limbs_of([1, 19, fe.P - 1])
+        d = fe.sub(zero, small)
+        assert ints_of(d) == [(fe.P - 1), (fe.P - 19), 1]
+
+    def test_invert(self):
+        vals = [3, 12345, fe.P - 2, 2**200 + 7]
+        inv = fe.invert(limbs_of(vals))
+        assert ints_of(inv) == [pow(v, fe.P - 2, fe.P) for v in vals]
+
+    def test_freeze_canonicalizes(self):
+        # p and 2p-1 etc. must freeze to their canonical residues.
+        raw = [fe.P, fe.P + 5, 2 * fe.P - 1, 0, 1]
+        arr = jnp.asarray(np.stack([fe.int_to_limbs(v) for v in raw], axis=1))
+        assert ints_of(arr) == [v % fe.P for v in raw]
+
+
+class TestPoints:
+    def test_base_point_on_curve_and_order(self):
+        # 2B computed by add(B, B) and double(B) must agree.
+        b = ed.base_point(())
+        d1 = ed.double(b)
+        d2 = ed.add(b, b)
+        assert bool(ed.equal(d1, d2))
+
+    def test_identity_is_neutral(self):
+        b = ed.base_point(())
+        assert bool(ed.equal(ed.add(b, ed.identity(())), b))
+
+    def test_negation_cancels(self):
+        b = ed.base_point(())
+        assert bool(ed.equal(ed.add(b, ed.negate(b)), ed.identity(())))
+
+    def test_decompress_base_point(self):
+        # Compressed base point: y with sign bit of x (x_B is even -> 0).
+        y = ed._BY
+        point, valid = ed.decompress(limbs_of([y]), jnp.asarray([0]))
+        assert bool(valid[0])
+        assert ints_of(point.x)[0] == ed._BX
+
+    def test_decompress_rejects_non_square(self):
+        # y = 2 gives u/v that is not a QR for edwards25519.
+        point, valid = ed.decompress(limbs_of([2]), jnp.asarray([0]))
+        assert not bool(valid[0])
+
+
+def make_sigs(n, msg_prefix=b"m"):
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    msgs, sigs, keys = [], [], []
+    for i in range(n):
+        sk = Ed25519PrivateKey.generate()
+        pk = sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        m = msg_prefix + b"-%d" % i
+        msgs.append(m)
+        sigs.append(sk.sign(m))
+        keys.append(pk)
+    return msgs, sigs, keys
+
+
+class TestBatchVerifier:
+    def test_rfc8032_vectors(self):
+        # RFC 8032 §7.1 test vectors 1-3.
+        vectors = [
+            (  # TEST 1: empty message
+                "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+                "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+                "",
+                "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+                "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+            ),
+            (  # TEST 2: one byte
+                "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+                "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+                "72",
+                "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+                "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+            ),
+            (  # TEST 3: two bytes
+                "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+                "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+                "af82",
+                "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+                "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+            ),
+        ]
+        msgs = [bytes.fromhex(m) for _, _, m, _ in vectors]
+        keys = [bytes.fromhex(pk) for _, pk, _, _ in vectors]
+        sigs = [bytes.fromhex(s) for _, _, _, s in vectors]
+        ok = Ed25519BatchVerifier().verify_batch(msgs, sigs, keys)
+        assert ok.all()
+
+    def test_valid_batch_and_each_corruption_mode(self):
+        msgs, sigs, keys = make_sigs(8)
+        v = Ed25519BatchVerifier()
+        assert v.verify_batch(msgs, sigs, keys).all()
+
+        bad = list(sigs)
+        bad[0] = bytes([sigs[0][0] ^ 1]) + sigs[0][1:]      # flipped R byte
+        bad[1] = sigs[1][:32] + bytes(32)                   # S = 0
+        bad[2] = b"short"                                   # malformed
+        bad[3] = sigs[3][:63] + bytes([sigs[3][63] ^ 0x40])  # flipped S bit
+        ok = v.verify_batch(msgs, bad, keys)
+        assert not ok[:4].any() and ok[4:].all()
+
+        wrong_msg = [b"x" + m for m in msgs]
+        assert not v.verify_batch(wrong_msg, sigs, keys).any()
+
+        swapped = keys[1:] + keys[:1]
+        assert not v.verify_batch(msgs, sigs, swapped).any()
+
+    def test_high_s_rejected(self):
+        # S >= L must be rejected even if the curve equation would hold.
+        from consensus_tpu.models.ed25519 import L
+
+        msgs, sigs, keys = make_sigs(1)
+        s = int.from_bytes(sigs[0][32:], "little")
+        high_s = s + L
+        forged = sigs[0][:32] + high_s.to_bytes(32, "little")
+        ok = Ed25519BatchVerifier().verify_batch(msgs, [forged], keys)
+        assert not ok[0]
+
+    def test_pow2_padding_returns_exact_length(self):
+        msgs, sigs, keys = make_sigs(5)
+        ok = Ed25519BatchVerifier(pad_pow2=True).verify_batch(msgs, sigs, keys)
+        assert ok.shape == (5,) and ok.all()
+
+    def test_host_fallback_matches_device(self):
+        msgs, sigs, keys = make_sigs(4)
+        bad = list(sigs)
+        bad[2] = bytes(64)
+        device = Ed25519BatchVerifier(min_device_batch=1).verify_batch(msgs, bad, keys)
+        host = Ed25519BatchVerifier(min_device_batch=100).verify_batch(msgs, bad, keys)
+        assert (device == host).all()
+
+
+class _Ed25519OnlyVerifier(Ed25519VerifierMixin):
+    """Concrete mixin instance for the signature-path tests."""
+
+    def verify_proposal(self, proposal):
+        return []
+
+    def verify_request(self, raw_request):
+        raise NotImplementedError
+
+    def verification_sequence(self):
+        return 0
+
+    def requests_from_proposal(self, proposal):
+        return []
+
+
+class TestVerifierPort:
+    def test_sign_proposal_then_batch_verify_quorum(self):
+        signers = {i: Ed25519Signer(i) for i in (1, 2, 3, 4)}
+        verifier = _Ed25519OnlyVerifier(
+            {i: s.public_bytes for i, s in signers.items()}
+        )
+        proposal = Proposal(payload=b"batch", metadata=b"md")
+        sigs = [signers[i].sign_proposal(proposal, b"aux-%d" % i) for i in (2, 3, 4)]
+        results = verifier.verify_consenter_sigs_batch(sigs, proposal)
+        assert results == [b"aux-2", b"aux-3", b"aux-4"]
+
+        # Tampered aux breaks the binding (the signature covers it).
+        tampered = Signature(id=2, value=sigs[0].value, msg=b"aux-x")
+        assert verifier.verify_consenter_sigs_batch([tampered], proposal) == [None]
+        # Signature over one proposal does not verify another.
+        other = Proposal(payload=b"other")
+        assert verifier.verify_consenter_sigs_batch(sigs, other) == [None] * 3
+
+    def test_unknown_signer_rejected(self):
+        signer = Ed25519Signer(9)
+        verifier = _Ed25519OnlyVerifier({1: Ed25519Signer(1).public_bytes})
+        proposal = Proposal(payload=b"p")
+        sig = signer.sign_proposal(proposal)
+        assert verifier.verify_consenter_sigs_batch([sig], proposal) == [None]
+
+    def test_verify_signature_raw_path(self):
+        signer = Ed25519Signer(3)
+        verifier = _Ed25519OnlyVerifier({3: signer.public_bytes})
+        data = b"view-data-bytes"
+        sig = Signature(id=3, value=signer.sign(data), msg=data)
+        verifier.verify_signature(sig)  # must not raise
+        with pytest.raises(ValueError):
+            verifier.verify_signature(Signature(id=3, value=bytes(64), msg=data))
+
+
+class TestCoalescer:
+    def test_merges_submissions_into_one_batch(self):
+        s = SimScheduler()
+        calls = []
+
+        def run(items):
+            calls.append(list(items))
+            return [x * 2 for x in items]
+
+        c = BatchCoalescer(s, run, window=0.002, max_batch=100)
+        got = {}
+        c.submit([1, 2], lambda r: got.update(a=list(r)))
+        c.submit([3], lambda r: got.update(b=list(r)))
+        assert calls == []  # window open, nothing flushed yet
+        s.advance(0.002)
+        assert calls == [[1, 2, 3]]
+        assert got == {"a": [2, 4], "b": [6]}
+
+    def test_max_batch_flushes_early(self):
+        s = SimScheduler()
+        calls = []
+        c = BatchCoalescer(s, lambda items: (calls.append(len(items)), items)[1],
+                           window=10.0, max_batch=4)
+        c.submit([1, 2], lambda r: None)
+        c.submit([3, 4], lambda r: None)
+        assert calls == [4]  # flushed without waiting for the window
+        assert s.now() == 0.0
+
+    def test_empty_submission_completes_immediately(self):
+        s = SimScheduler()
+        c = BatchCoalescer(s, lambda items: items, window=1.0)
+        out = []
+        c.submit([], out.append)
+        assert out == [[]]
+
+
+class TestSharding:
+    def test_sharded_matches_single_device(self):
+        import jax
+
+        from consensus_tpu.parallel import ShardedEd25519Verifier, make_mesh
+
+        msgs, sigs, keys = make_sigs(12)
+        bad = list(sigs)
+        bad[5] = bytes(64)
+        mesh = make_mesh()
+        assert mesh.devices.size == 8  # conftest forces the virtual mesh
+        sharded = ShardedEd25519Verifier(mesh).verify_batch(msgs, bad, keys)
+        single = Ed25519BatchVerifier().verify_batch(msgs, bad, keys)
+        assert (sharded == single).all()
+        assert sharded.sum() == 11 and not sharded[5]
+
+    def test_graft_entry_contract(self):
+        import importlib
+        import sys
+
+        sys.path.insert(0, "/root/repo")
+        g = importlib.import_module("__graft_entry__")
+        import jax
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (8,) and bool(out[0]) and not bool(out[1])
+        g.dryrun_multichip(8)

@@ -1,0 +1,25 @@
+"""Metrics: provider behavior + end-to-end instrument wiring through a
+live cluster.  Parity model: reference pkg/api/metrics.go bundles."""
+
+
+def test_metrics_record_protocol_activity():
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+    from consensus_tpu.testing import Cluster, make_request
+
+    provider = InMemoryProvider()
+    cluster = Cluster(4)
+    cluster.nodes[2].metrics = Metrics(provider)  # instrument one replica
+    cluster.start()
+    for i in range(3):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1)
+
+    assert provider.value("view_count_batch_all") == 3
+    assert provider.value("view_count_txs_all") == 3
+    assert provider.value("pool_count_of_elements_all") >= 3
+    assert provider.value("pool_count_of_elements") == 0  # all delivered
+    assert len(provider.observations("pool_latency_of_elements")) >= 3
+    assert len(provider.observations("view_latency_batch_processing")) == 3
+    assert len(provider.observations("view_latency_batch_save")) == 3
+    assert provider.value("view_proposal_sequence") >= 3
+    assert provider.value("view_number") == 0
