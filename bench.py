@@ -22,7 +22,7 @@ import json
 import sys
 import time
 
-BATCH = 8192
+BATCH = 16384
 DEVICE_ITERS = 5
 HOST_SAMPLE = 512
 
@@ -50,15 +50,43 @@ def make_signatures(n: int):
 
 
 def bench_device(msgs, sigs, keys) -> float:
+    """Pipelined end-to-end throughput: host preparation of batch i+1
+    overlaps device execution of batch i (what a serving replica does), so
+    steady-state throughput is max(prep, device) rather than their sum."""
+    import concurrent.futures
+
+    import numpy as np
+
     from consensus_tpu.models import Ed25519BatchVerifier
+    from consensus_tpu.models.ed25519 import _verify_kernel, to_kernel_layout
+
+    from consensus_tpu.models.ed25519 import _next_pow2
+
+    # The timed loop feeds _prepare output straight to the kernel, so the
+    # batch size must already be the shape warmup compiled (padding happens
+    # only inside verify_batch).
+    assert len(msgs) == _next_pow2(len(msgs)), "BATCH must be a power of two >= 8"
 
     verifier = Ed25519BatchVerifier()
     ok = verifier.verify_batch(msgs, sigs, keys)  # warmup: compiles the kernel
     assert ok.all(), "benchmark signatures must verify"
-    start = time.perf_counter()
-    for _ in range(DEVICE_ITERS):
-        verifier.verify_batch(msgs, sigs, keys)
-    elapsed = time.perf_counter() - start
+
+    def prep():
+        return to_kernel_layout(*verifier._prepare(msgs, sigs, keys))
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+        # The first prep is inside the timed region: every counted batch
+        # pays its preparation in the window (no free pipeline fill).
+        start = time.perf_counter()
+        pending = pool.submit(prep)
+        results = []
+        for _ in range(DEVICE_ITERS):
+            args = pending.result()
+            pending = pool.submit(prep)  # overlap next prep with this launch
+            results.append(_verify_kernel(*args))
+        total_valid = sum(int(np.asarray(r).sum()) for r in results)
+        elapsed = time.perf_counter() - start
+    assert total_valid == len(msgs) * DEVICE_ITERS
     return len(msgs) * DEVICE_ITERS / elapsed
 
 

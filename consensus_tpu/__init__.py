@@ -4,7 +4,9 @@ A library-form PBFT-style consensus core (pre-prepare / prepare / commit with
 depth-1 pipelining, view changes with in-flight agreement, leader rotation and
 blacklisting, heartbeats, state transfer, CRC-chained WAL crash recovery, and
 dynamic reconfiguration), with the signature-heavy protocol paths drained into
-batched JAX/XLA verification kernels (ECDSA-P256 / Ed25519) that run on TPU.
+a batched JAX/XLA Ed25519 verification kernel that runs on TPU (f32 limb
+field arithmetic on the VPU, windowed double-scalar multiplication, batch
+axis shardable across a device mesh).
 
 Capability parity target: hyperledger-labs/SmartBFT (see SURVEY.md).  The
 architecture is deliberately *not* a port:
@@ -22,14 +24,15 @@ architecture is deliberately *not* a port:
 
 Layout:
     api/       dependency-injection ports (the seam applications implement)
-    wire/      protobuf wire format + WAL record schema
+    wire/      message schema + deterministic binary codec
     wal/       segmented CRC-chained write-ahead log
     runtime/   deterministic clock + event scheduler
     core/      the consensus protocol state machines
-    ops/       TPU big-integer / modular-field kernels (jnp, vmap, pallas)
-    models/    batched signature-verification models built on ops/
+    ops/       GF(2^255-19) limb arithmetic + edwards25519 group ops (JAX)
+    models/    batched signature verification + signer/verifier adapters
     parallel/  device-mesh sharding of the crypto batch path
-    utils/     quorum math, leader selection, blacklist, codecs
+    metrics    provider abstraction + the 5 instrument bundles
+    utils/     quorum math, leader selection, blacklist, digests
     testing/   in-process simulated network + all-ports test application
 """
 

@@ -112,12 +112,16 @@ def _prep_compressed(points: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray, n
     Fully vectorized: byte rows -> unpacked bits -> grouped limb dot; the
     canonical-range check (y < p) is a lexicographic byte comparison."""
     n = len(points)
-    rows = np.zeros((n, 32), dtype=np.uint8)
-    ok = np.zeros(n, dtype=bool)
+    ok = np.ones(n, dtype=bool)
+    chunks: list[bytes] = []
     for i, raw in enumerate(points):
         if len(raw) == 32:
-            rows[i] = np.frombuffer(raw, dtype=np.uint8)
-            ok[i] = True
+            chunks.append(raw)
+        else:
+            ok[i] = False
+            chunks.append(b"\x00" * 32)
+    # One bulk copy instead of n tiny frombuffer calls.
+    rows = np.frombuffer(b"".join(chunks), dtype=np.uint8).reshape(n, 32)
     signs = (rows[:, 31] >> 7).astype(np.int32)
     rows = rows.copy()
     rows[:, 31] &= 0x7F
@@ -190,31 +194,36 @@ class Ed25519BatchVerifier:
         ``(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok)``."""
         n = len(messages)
         host_ok = np.ones(n, dtype=bool)
+        zeros32 = b"\x00" * 32
         r_bytes: list[bytes] = []
-        s_rows = np.zeros((n, 32), dtype=np.uint8)
-        k_rows = np.zeros((n, 32), dtype=np.uint8)
+        s_chunks: list[bytes] = []
+        k_chunks: list[bytes] = []
+        sha512 = hashlib.sha512
+        from_bytes = int.from_bytes
         for i in range(n):
             sig = signatures[i]
             if len(sig) != 64:
                 host_ok[i] = False
-                r_bytes.append(b"\x00" * 32)
+                r_bytes.append(zeros32)
+                s_chunks.append(zeros32)
+                k_chunks.append(zeros32)
                 continue
             r_raw, s_raw = sig[:32], sig[32:]
             r_bytes.append(r_raw)
-            s = int.from_bytes(s_raw, "little")
-            if s >= L:  # malleability check, RFC 8032 §5.1.7
+            if from_bytes(s_raw, "little") >= L:  # malleability, RFC 8032 §5.1.7
                 host_ok[i] = False
+                s_chunks.append(zeros32)
+                k_chunks.append(zeros32)
                 continue
             k = (
-                int.from_bytes(
-                    hashlib.sha512(r_raw + public_keys[i] + messages[i]).digest(),
-                    "little",
-                )
+                from_bytes(sha512(r_raw + public_keys[i] + messages[i]).digest(), "little")
                 % L
             )
-            s_rows[i] = np.frombuffer(s_raw, dtype=np.uint8)
-            k_rows[i] = np.frombuffer(k.to_bytes(32, "little"), dtype=np.uint8)
-        # Byte rows -> bit arrays in one vectorized unpack.
+            s_chunks.append(s_raw)
+            k_chunks.append(k.to_bytes(32, "little"))
+        # Bulk copies + one vectorized unpack (no per-row numpy calls).
+        s_rows = np.frombuffer(b"".join(s_chunks), dtype=np.uint8).reshape(n, 32)
+        k_rows = np.frombuffer(b"".join(k_chunks), dtype=np.uint8).reshape(n, 32)
         s_bits = _bytes_rows_to_bits(s_rows)
         k_bits = _bytes_rows_to_bits(k_rows)
 

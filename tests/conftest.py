@@ -18,4 +18,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402  (already imported at startup; this is a no-op)
 
+# Restrict backend *initialization* to CPU — not just selection.  Without
+# this, enumerating devices initializes the TPU tunnel plugin too, and a
+# wedged tunnel then hangs even CPU-only tests.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_platform_name", "cpu")

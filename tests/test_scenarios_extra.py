@@ -1,0 +1,116 @@
+"""Additional multi-replica scenarios mirroring the reference's deeper
+basic_test.go coverage: gradual start, fork attempts, speed-up view change,
+and blacklist rotation after a leader failure.
+"""
+
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.wire import PrePrepare, decode_view_metadata
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 60.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+}
+
+
+def test_gradual_start_still_orders():
+    # Parity model: reference TestGradualStart (basic_test.go:1413) — nodes
+    # join one by one; once a quorum is up, ordering proceeds, and the last
+    # joiner catches up.
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.nodes[1].start()
+    cluster.scheduler.advance(1.0)
+    cluster.nodes[2].start()
+    cluster.scheduler.advance(1.0)
+    cluster.nodes[3].start()  # quorum reached
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, node_ids=[1, 2, 3], max_time=300.0)
+
+    cluster.nodes[4].start()
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[1, 2, 3], max_time=300.0)
+    cluster.scheduler.advance(120.0)  # straggler sync window
+    assert len(cluster.nodes[4].app.ledger) >= 1
+    cluster.assert_ledgers_consistent()
+
+
+def test_equivocating_leader_cannot_fork():
+    # Parity model: reference TestViewChangeAfterTryingToFork
+    # (basic_test.go:2492) — the leader equivocates, sending one proposal to
+    # half the followers and a different one to the rest. No quorum can
+    # prepare either, the leader is deposed, and no fork ever appears.
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+
+    def mutate(sender, target, msg):
+        if sender == 1 and isinstance(msg, PrePrepare) and target in (3, 4):
+            forked = msg.proposal.__class__(
+                payload=msg.proposal.payload + b"|forked",
+                header=msg.proposal.header,
+                metadata=msg.proposal.metadata,
+                verification_sequence=msg.proposal.verification_sequence,
+            )
+            return PrePrepare(
+                view=msg.view, seq=msg.seq, proposal=forked,
+                prev_commit_signatures=msg.prev_commit_signatures,
+            )
+        return msg
+
+    cluster.network.mutate_send = mutate
+    cluster.submit_to_all(make_request("c", 0))
+    cluster.scheduler.advance(3.0)
+    # Neither variant can commit.
+    assert all(len(n.app.ledger) == 0 for n in cluster.nodes.values())
+
+    cluster.network.mutate_send = None
+    assert cluster.run_until_ledger(1, node_ids=[2, 3, 4], max_time=600.0)
+    cluster.assert_ledgers_consistent()  # common-prefix equality == no fork
+    heights = {
+        n_id: [d.proposal.digest() for d in n.app.ledger]
+        for n_id, n in cluster.nodes.items()
+        if n.running
+    }
+    first_blocks = {v[0] for v in heights.values() if v}
+    assert len(first_blocks) == 1, f"forked first block: {heights}"
+
+
+def test_speed_up_view_change_joins_at_f_plus_one():
+    # speed_up_view_change joins a view change at f+1 votes instead of
+    # quorum-1 (reference viewchanger.go:393-399).
+    cluster = Cluster(7, config_tweaks=dict(FAST, speed_up_view_change=True))
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 1))
+    alive = [2, 3, 4, 5, 6, 7]
+    assert cluster.run_until_ledger(2, node_ids=alive, max_time=600.0)
+    cluster.assert_ledgers_consistent()
+
+
+def test_failed_leader_lands_on_blacklist_with_rotation():
+    # With rotation active, a leader skipped over by a view change must be
+    # blacklisted in subsequent proposal metadata (reference util.go:436-497,
+    # validated by followers via view.go:649-716).
+    cluster = Cluster(
+        4, leader_rotation=True,
+        config_tweaks=dict(FAST, decisions_per_leader=100),
+    )
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    # Leader of view 0 with an empty blacklist is node 1; kill it.
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=600.0)
+
+    decision = cluster.nodes[2].app.ledger[-1]
+    md = decode_view_metadata(decision.proposal.metadata)
+    assert 1 in md.black_list, f"deposed leader not blacklisted: {md}"
+    # And ordering continues under the blacklist regime.
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, node_ids=[2, 3, 4], max_time=600.0)
+    cluster.assert_ledgers_consistent()
