@@ -31,6 +31,7 @@ Layout:
     ops/       GF(2^255-19) limb arithmetic + edwards25519 group ops (JAX)
     models/    batched signature verification + signer/verifier adapters
     parallel/  device-mesh sharding of the crypto batch path
+    net/       production TCP transport (Comm over the datacenter network)
     metrics    provider abstraction + the 5 instrument bundles
     utils/     quorum math, leader selection, blacklist, digests
     testing/   in-process simulated network + all-ports test application

@@ -1,0 +1,5 @@
+"""Network transports: production Comm implementations (TCP over DCN)."""
+
+from consensus_tpu.net.transport import MAX_FRAME_BYTES, TcpComm
+
+__all__ = ["TcpComm", "MAX_FRAME_BYTES"]
