@@ -1,11 +1,11 @@
-"""TCP transport: a production ``Comm`` implementation over real sockets.
+"""TCP transport: a ``Comm`` implementation over real sockets.
 
 The reference ships no in-tree transport — Fabric supplies a gRPC/mTLS
 cluster service and the tests use channel maps (reference
 pkg/api/dependencies.go:22-30, test/network.go).  This module provides the
-equivalent first-class piece: length-framed messages over TCP between
-replica hosts (BFT traffic rides the datacenter network — DCN; ICI is for
-the co-located accelerator, not inter-replica consensus).
+socket transport piece: length-framed messages over TCP between replica
+hosts (BFT traffic rides the datacenter network — DCN; ICI is for the
+co-located accelerator, not inter-replica consensus).
 
 Contract fidelity: ``Comm`` is *fire-and-forget, unordered, unreliable*
 (the protocol tolerates loss).  Accordingly: sends never block the replica
@@ -14,12 +14,24 @@ messages silently and trigger lazy reconnection with backoff, and inbound
 frames are posted onto the replica's scheduler (thread-safe with
 ``RealtimeScheduler``).
 
-Frame: u32 length | u64 sender id | u8 kind (0 = consensus, 1 = request) |
-payload (``wire.encode_message`` bytes, or raw request bytes).
+Identity: every connection opens with a HELLO frame that *pins* the peer id
+for that connection; later frames claiming another sender kill the link.
+With ``auth_secret`` set, the HELLO carries an HMAC-SHA256 proof, so only
+holders of the cluster secret can claim an identity.  This is connection-
+level replica authentication, NOT transport encryption — for adversarial
+networks, terminate TLS in front (stunnel/envoy) or swap in an mTLS
+transport behind the same ``Comm`` port.  (Protocol-level safety does not
+rest on the transport: consenter signatures are verified end-to-end.)
+
+Frame: u32 length | u64 sender id | u8 kind (0 = consensus, 1 = request,
+2 = hello) | payload (``wire.encode_message`` bytes, raw request bytes, or
+the HELLO proof).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import logging
 import queue
 import socket
@@ -35,6 +47,16 @@ logger = logging.getLogger("consensus_tpu.net")
 _HEADER = struct.Struct(">IQB")
 _KIND_CONSENSUS = 0
 _KIND_REQUEST = 1
+_KIND_HELLO = 2
+_HELLO_CONTEXT = b"consensus-tpu/hello/v1"
+
+
+def _hello_proof(secret: Optional[bytes], sender: int) -> bytes:
+    if not secret:
+        return b""
+    return hmac.new(
+        secret, _HELLO_CONTEXT + struct.pack(">Q", sender), hashlib.sha256
+    ).digest()
 #: Frames larger than this are assumed corrupt and kill the connection.
 MAX_FRAME_BYTES = 64 * 1024 * 1024
 
@@ -57,6 +79,7 @@ class TcpComm(Comm):
         send_queue_depth: int = 1000,
         reconnect_backoff: float = 0.5,
         connect_timeout: float = 2.0,
+        auth_secret: Optional[bytes] = None,
     ) -> None:
         self.self_id = self_id
         self._addresses = dict(addresses)
@@ -64,6 +87,10 @@ class TcpComm(Comm):
         self._queue_depth = send_queue_depth
         self._backoff = reconnect_backoff
         self._connect_timeout = connect_timeout
+        self._auth_secret = auth_secret
+        # One-slot encode memo: broadcasts send the same message object to
+        # n-1 peers back to back; encode it once (single-threaded caller).
+        self._encode_memo: tuple[Optional[object], bytes] = (None, b"")
         self._peers: dict[int, "_Peer"] = {}
         self._listener: Optional[socket.socket] = None
         self._inbound: set[socket.socket] = set()
@@ -123,7 +150,13 @@ class TcpComm(Comm):
     # --- Comm port ---------------------------------------------------------
 
     def send_consensus(self, target_id: int, message: ConsensusMessage) -> None:
-        self._send(target_id, _KIND_CONSENSUS, encode_message(message))
+        memo_obj, memo_bytes = self._encode_memo
+        if memo_obj is message:
+            payload = memo_bytes
+        else:
+            payload = encode_message(message)
+            self._encode_memo = (message, payload)
+        self._send(target_id, _KIND_CONSENSUS, payload)
 
     def send_transaction(self, target_id: int, request: bytes) -> None:
         self._send(target_id, _KIND_REQUEST, bytes(request))
@@ -154,7 +187,14 @@ class TcpComm(Comm):
             try:
                 conn, _ = self._listener.accept()
             except OSError:
-                return
+                if self._stopped.is_set():
+                    return
+                # Transient accept failure (ECONNABORTED, fd pressure):
+                # keep serving — a dead accept loop would silently
+                # partition this replica on the receive side.
+                logger.warning("%d: accept failed; retrying", self.self_id, exc_info=True)
+                self._stopped.wait(0.05)
+                continue
             with self._inbound_lock:
                 if self._stopped.is_set():
                     conn.close()
@@ -168,6 +208,7 @@ class TcpComm(Comm):
             ).start()
 
     def _receive_loop(self, conn: socket.socket) -> None:
+        pinned_sender: Optional[int] = None
         try:
             while not self._stopped.is_set():
                 header = _read_exact(conn, _HEADER.size)
@@ -179,6 +220,30 @@ class TcpComm(Comm):
                     return
                 payload = _read_exact(conn, length)
                 if payload is None:
+                    return
+                if pinned_sender is None:
+                    # First frame must be the HELLO that pins this
+                    # connection's identity (optionally HMAC-proven).
+                    if kind != _KIND_HELLO:
+                        logger.warning(
+                            "%d: connection sent %d before HELLO; dropping link",
+                            self.self_id, kind,
+                        )
+                        return
+                    expected = _hello_proof(self._auth_secret, sender)
+                    if not hmac.compare_digest(payload, expected):
+                        logger.warning(
+                            "%d: bad HELLO proof for claimed sender %d; dropping link",
+                            self.self_id, sender,
+                        )
+                        return
+                    pinned_sender = sender
+                    continue
+                if sender != pinned_sender:
+                    logger.warning(
+                        "%d: frame claims sender %d on connection pinned to %d; dropping link",
+                        self.self_id, sender, pinned_sender,
+                    )
                     return
                 self._dispatch(sender, kind, payload)
         finally:
@@ -263,6 +328,10 @@ class _Peer:
             )
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            proof = _hello_proof(self._comm._auth_secret, self._comm.self_id)
+            sock.sendall(
+                _HEADER.pack(len(proof), self._comm.self_id, _KIND_HELLO) + proof
+            )
             self._sock = sock
             logger.info(
                 "%d: connected to peer %d at %s:%d",

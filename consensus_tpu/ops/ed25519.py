@@ -82,30 +82,39 @@ _D2 = fe.D2
 
 
 def add(p: Point, q: Point) -> Point:
-    """add-2008-hwcd-3: 8M + 1 constant mul."""
-    a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
-    b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
+    """add-2008-hwcd-3: 8M + 1 constant mul.
+
+    Every intermediate add/sub stays *unreduced* (one raw level, limb bound
+    600/680) and feeds straight into a multiplication — all operand-bound
+    products stay under the 2^19 exactness budget, so the formula needs no
+    carry passes outside the multiplies themselves."""
+    a = fe.mul(fe.sub_raw(p.y, p.x), fe.sub_raw(q.y, q.x))
+    b = fe.mul(fe.add_raw(p.y, p.x), fe.add_raw(q.y, q.x))
     c = fe.mul(fe.mul(p.t, fe.constant_like(_D2, p.t)), q.t)
-    d = fe.mul(fe.add(p.z, p.z), q.z)
-    e = fe.sub(b, a)
-    f = fe.sub(d, c)
-    g = fe.add(d, c)
-    h = fe.add(b, a)
+    d = fe.mul(fe.add_raw(p.z, p.z), q.z)
+    e = fe.sub_raw(b, a)
+    f = fe.sub_raw(d, c)
+    g = fe.add_raw(d, c)
+    h = fe.add_raw(b, a)
     return Point(x=fe.mul(e, f), y=fe.mul(g, h), z=fe.mul(f, g), t=fe.mul(e, h))
 
 
 def double(p: Point, *, need_t: bool = True) -> Point:
     """dbl-2008-hwcd: 4M + 4S (3M + 4S with ``need_t=False`` — the T input
-    is never read by doubling, so runs of doubles skip producing it)."""
+    is never read by doubling, so runs of doubles skip producing it).
+
+    Lazy-reduction layout: A/B/ZZ use the half-cost specialized squaring
+    (inputs weakly reduced), C/H/G/XY stay raw; only E and F — whose raw
+    bounds would overflow the multiply budget — get reduced."""
     a = fe.square(p.x)
     b = fe.square(p.y)
-    c = fe.square(p.z)
-    c = fe.add(c, c)
-    h = fe.add(a, b)
-    xy = fe.add(p.x, p.y)
-    e = fe.sub(h, fe.square(xy))
-    g = fe.sub(a, b)
-    f = fe.add(c, g)
+    zz = fe.square(p.z)
+    c = fe.add_raw(zz, zz)          # <= 680
+    h = fe.add_raw(a, b)            # <= 680
+    xy = fe.add_raw(p.x, p.y)       # <= 680: square() bound is 500 -> mul
+    e = fe.sub(h, fe.mul(xy, xy))   # reduced: raw h - weak square
+    g = fe.sub_raw(a, b)            # <= 600
+    f = fe.add(c, g)                # reduced: 680 + 600 would exceed 724
     t = fe.mul(e, h) if need_t else p.t
     return Point(x=fe.mul(e, f), y=fe.mul(g, h), z=fe.mul(f, g), t=t)
 

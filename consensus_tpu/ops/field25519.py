@@ -133,6 +133,27 @@ def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _weak_reduce(a + b)
 
 
+# --- lazy (unreduced) ops -------------------------------------------------
+# Exactness budget: mul/square require |a_limb| * |b_limb| * 32 < 2^24,
+# i.e. the product of the two operands' limb bounds must stay under 2^19
+# (724^2).  Weakly reduced values have |limb| <= 340, so ONE level of
+# unreduced add/sub (|limb| <= 680 / 600) can feed a multiplication
+# directly — the curve formulas exploit this to skip ~half their carry
+# passes.  Never stack two raw levels into a multiply.
+
+
+def add_raw(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b without reduction: |limb| grows to |a| + |b| (<= 680 for two
+    weakly reduced inputs — still multiplication-safe)."""
+    return a + b
+
+
+def sub_raw(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b (bias 2p) without reduction: for weakly reduced inputs the
+    limbs stay within [-345, 600] — multiplication-safe."""
+    return a + _cexpand(_TWO_P, a) - b
+
+
 #: 2p = 2^256 - 38 fits exactly in 32 limbs (top limb 255).
 _TWO_P = np.array(
     [((2 * P) >> (LIMB_BITS * i)) & 0xFF for i in range(LIMBS)], dtype=np.float32
@@ -144,25 +165,44 @@ def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _weak_reduce(a + _cexpand(_TWO_P, a) - b)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Batched field multiplication: schoolbook convolution as 32 broadcast
-    multiplies + shifted adds (full-lane VPU work), then parallel folds.
-
-    Weakly reduced inputs (|limb| <= 340) keep every column below
-    32 * 340^2 < 2^22 — exact in f32."""
-    batch_pad = [(0, 0)] * (a.ndim - 1)
-    terms = [
-        jnp.pad(a[i] * b, [(i, LIMBS - 1 - i)] + batch_pad) for i in range(LIMBS)
-    ]
-    cols = sum(terms)  # (63, *batch)
+def _reduce_cols(cols: jnp.ndarray) -> jnp.ndarray:
+    """(63, *batch) schoolbook columns (|col| < 2^24) -> weakly reduced."""
     lo, hi = _split(cols)
     c = jnp.concatenate([lo[:1], lo[1:] + hi[:-1], hi[-1:]], axis=0)  # width 64
     r = c[:LIMBS] + c[LIMBS:] * FOLD  # |r| < 2^19
     return _weak_reduce(r)
 
 
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Batched field multiplication: schoolbook convolution as 32 broadcast
+    multiplies + shifted adds (full-lane VPU work), then parallel folds.
+
+    Exact while |a_limb| * |b_limb| <= 2^19 (columns sum 32 products under
+    the f32 24-bit integer window) — weakly reduced inputs and one raw
+    add/sub level both qualify."""
+    batch_pad = [(0, 0)] * (a.ndim - 1)
+    terms = [
+        jnp.pad(a[i] * b, [(i, LIMBS - 1 - i)] + batch_pad) for i in range(LIMBS)
+    ]
+    return _reduce_cols(sum(terms))
+
+
 def square(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+    """Specialized squaring: the product matrix is symmetric, so only the
+    upper triangle is computed (cross terms doubled) — ~half the multiplies
+    of :func:`mul`.
+
+    Exactness requires |limb| <= 500 (2 * 500^2 * 32 < 2^24); callers with
+    one-raw-level inputs (bound 680) must use ``mul(x, x)`` instead."""
+    batch = a.shape[1:]
+    doubled = a + a
+    cols = jnp.zeros((2 * LIMBS - 1, *batch), dtype=jnp.float32)
+    for i in range(LIMBS):
+        # Diagonal a_i^2 at column 2i, doubled cross terms a_i*a_j (j > i)
+        # at columns i+j — one fused row per i, positions 2i .. i+31.
+        row = jnp.concatenate([a[i : i + 1] * a[i], doubled[i + 1 :] * a[i]], axis=0)
+        cols = cols.at[2 * i : i + LIMBS].add(row)
+    return _reduce_cols(cols)
 
 
 _P_LIMBS_I32 = np.array(
@@ -260,7 +300,9 @@ __all__ = [
     "from_int_broadcast",
     "zeros_like_batch",
     "add",
+    "add_raw",
     "sub",
+    "sub_raw",
     "mul",
     "square",
     "freeze",

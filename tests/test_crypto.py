@@ -83,6 +83,32 @@ class TestField:
         d = fe.sub(zero, small)
         assert ints_of(d) == [(fe.P - 1), (fe.P - 19), 1]
 
+
+    def test_raw_ops_stay_exact_at_bound(self):
+        # One raw add/sub level feeding mul must stay bit-exact: drive the
+        # worst-case limb magnitudes the curve formulas produce.
+        rng = random.Random(21)
+        vals = [rng.randrange(fe.P) for _ in range(8)]
+        others = [rng.randrange(fe.P) for _ in range(8)]
+        x, y = limbs_of(vals), limbs_of(others)
+        for _ in range(10):
+            s = fe.add_raw(x, y)        # <= 680 per limb
+            d = fe.sub_raw(x, y)        # in [-345, 600]
+            prod = fe.mul(s, d)         # raw x raw multiply
+            want = [((a + b) * (a - b)) % fe.P for a, b in zip(vals, others)]
+            assert ints_of(prod) == want
+            x, vals = prod, want
+            y = fe.mul(y, y)
+            others = [b * b % fe.P for b in others]
+
+    def test_square_matches_mul(self):
+        rng = random.Random(23)
+        vals = [rng.randrange(fe.P) for _ in range(8)] + [0, 1, fe.P - 1]
+        x = limbs_of(vals)
+        assert ints_of(fe.square(x)) == ints_of(fe.mul(x, x)) == [
+            v * v % fe.P for v in vals
+        ]
+
     def test_invert(self):
         vals = [3, 12345, fe.P - 2, 2**200 + 7]
         inv = fe.invert(limbs_of(vals))

@@ -184,3 +184,67 @@ def test_four_replicas_over_real_tcp_sockets():
                 rt.stop(timeout=2.0)
             except RuntimeError:
                 pass
+
+
+def test_hello_pins_sender_and_rejects_impersonation():
+    import struct
+
+    from consensus_tpu.net.transport import _HEADER, _KIND_HELLO
+
+    ports = free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    received = []
+    comm2 = TcpComm(2, addrs, lambda s, m, r: received.append((s, m)))
+    comm2.start()
+    try:
+        # A raw client claiming sender 1 in HELLO, then forging sender 3 in
+        # a later frame: the link must be dropped, nothing dispatched.
+        sock = socket.create_connection(("127.0.0.1", ports[1]), timeout=5)
+        sock.sendall(_HEADER.pack(0, 1, _KIND_HELLO))
+        from consensus_tpu.wire import encode_message
+
+        forged = encode_message(HeartBeat(view=0, seq=0))
+        sock.sendall(_HEADER.pack(len(forged), 3, 0) + forged)
+        time.sleep(0.3)
+        assert received == [], "forged-sender frame was dispatched"
+        # And a frame before HELLO is also rejected.
+        sock2 = socket.create_connection(("127.0.0.1", ports[1]), timeout=5)
+        sock2.sendall(_HEADER.pack(len(forged), 1, 0) + forged)
+        time.sleep(0.3)
+        assert received == []
+        sock.close()
+        sock2.close()
+    finally:
+        comm2.stop()
+
+
+def test_auth_secret_rejects_wrong_key():
+    ports = free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    received = []
+    got = threading.Event()
+    comm2 = TcpComm(2, addrs, lambda s, m, r: (received.append(m), got.set()),
+                    auth_secret=b"cluster-secret")
+    comm2.start()
+    bad = TcpComm(1, addrs, lambda *a: None, auth_secret=b"wrong-secret",
+                  reconnect_backoff=0.05)
+    bad.start()
+    try:
+        bad.send_consensus(2, HeartBeat(view=1, seq=1))
+        time.sleep(0.4)
+        assert received == [], "wrong-secret peer got through"
+        bad.stop()
+
+        # Fresh listen port for node 1 (the old listener may still be in
+        # teardown); only node 2's address matters for this direction.
+        addrs_good = {1: ("127.0.0.1", free_ports(1)[0]), 2: addrs[2]}
+        good = TcpComm(1, addrs_good, lambda *a: None, auth_secret=b"cluster-secret")
+        good.start()
+        try:
+            good.send_consensus(2, HeartBeat(view=2, seq=2))
+            assert got.wait(5.0), "right-secret peer was rejected"
+            assert received[0].view == 2
+        finally:
+            good.stop()
+    finally:
+        comm2.stop()
