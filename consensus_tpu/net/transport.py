@@ -16,8 +16,9 @@ frames are posted onto the replica's scheduler (thread-safe with
 
 Identity: every connection opens with a HELLO frame that *pins* the peer id
 for that connection; later frames claiming another sender kill the link.
-With ``auth_secret`` set, the HELLO carries an HMAC-SHA256 proof, so only
-holders of the cluster secret can claim an identity.  This is connection-
+With ``auth_secret`` set, the acceptor issues a fresh challenge nonce and
+the HELLO carries an HMAC-SHA256 proof over it, so only live holders of the
+cluster secret can claim an identity (observed handshakes don't replay).  This is connection-
 level replica authentication, NOT transport encryption — for adversarial
 networks, terminate TLS in front (stunnel/envoy) or swap in an mTLS
 transport behind the same ``Comm`` port.  (Protocol-level safety does not
@@ -33,6 +34,7 @@ from __future__ import annotations
 import hashlib
 import hmac
 import logging
+import os
 import queue
 import socket
 import struct
@@ -49,13 +51,16 @@ _KIND_CONSENSUS = 0
 _KIND_REQUEST = 1
 _KIND_HELLO = 2
 _HELLO_CONTEXT = b"consensus-tpu/hello/v1"
+_NONCE_BYTES = 16
 
 
-def _hello_proof(secret: Optional[bytes], sender: int) -> bytes:
+def _hello_proof(secret: Optional[bytes], nonce: bytes, sender: int) -> bytes:
+    """Per-connection proof: binds the cluster secret to the acceptor's
+    fresh nonce, so observed handshakes cannot be replayed."""
     if not secret:
         return b""
     return hmac.new(
-        secret, _HELLO_CONTEXT + struct.pack(">Q", sender), hashlib.sha256
+        secret, _HELLO_CONTEXT + nonce + struct.pack(">Q", sender), hashlib.sha256
     ).digest()
 #: Frames larger than this are assumed corrupt and kill the connection.
 MAX_FRAME_BYTES = 64 * 1024 * 1024
@@ -209,6 +214,12 @@ class TcpComm(Comm):
 
     def _receive_loop(self, conn: socket.socket) -> None:
         pinned_sender: Optional[int] = None
+        # Challenge: a fresh nonce per connection (replay protection).
+        nonce = os.urandom(_NONCE_BYTES)
+        try:
+            conn.sendall(_HEADER.pack(len(nonce), self.self_id, _KIND_HELLO) + nonce)
+        except OSError:
+            return
         try:
             while not self._stopped.is_set():
                 header = _read_exact(conn, _HEADER.size)
@@ -230,7 +241,7 @@ class TcpComm(Comm):
                             self.self_id, kind,
                         )
                         return
-                    expected = _hello_proof(self._auth_secret, sender)
+                    expected = _hello_proof(self._auth_secret, nonce, sender)
                     if not hmac.compare_digest(payload, expected):
                         logger.warning(
                             "%d: bad HELLO proof for claimed sender %d; dropping link",
@@ -326,9 +337,22 @@ class _Peer:
             sock = socket.create_connection(
                 self.addr, timeout=self._comm._connect_timeout
             )
-            sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            proof = _hello_proof(self._comm._auth_secret, self._comm.self_id)
+            # Read the acceptor's challenge nonce, answer with the proof.
+            sock.settimeout(self._comm._connect_timeout)
+            header = _read_exact(sock, _HEADER.size)
+            if header is None:
+                raise OSError("peer closed during handshake")
+            length, _, kind = _HEADER.unpack(header)
+            if kind != _KIND_HELLO or length != _NONCE_BYTES:
+                raise OSError("bad handshake challenge")
+            nonce = _read_exact(sock, length)
+            if nonce is None:
+                raise OSError("peer closed during handshake")
+            sock.settimeout(None)
+            proof = _hello_proof(
+                self._comm._auth_secret, nonce, self._comm.self_id
+            )
             sock.sendall(
                 _HEADER.pack(len(proof), self._comm.self_id, _KIND_HELLO) + proof
             )

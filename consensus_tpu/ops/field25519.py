@@ -169,7 +169,10 @@ def _reduce_cols(cols: jnp.ndarray) -> jnp.ndarray:
     """(63, *batch) schoolbook columns (|col| < 2^24) -> weakly reduced."""
     lo, hi = _split(cols)
     c = jnp.concatenate([lo[:1], lo[1:] + hi[:-1], hi[-1:]], axis=0)  # width 64
-    r = c[:LIMBS] + c[LIMBS:] * FOLD  # |r| < 2^19
+    # |r| <= ~2^21.2 with one-raw-level operands (columns up to ~1.48e7,
+    # hi < 2^15.9, fold x38) — inside _weak_reduce's 2^22 domain with ~1.8x
+    # margin.  Do NOT widen the lazy budget without redoing this analysis.
+    r = c[:LIMBS] + c[LIMBS:] * FOLD
     return _weak_reduce(r)
 
 
@@ -194,15 +197,17 @@ def square(a: jnp.ndarray) -> jnp.ndarray:
 
     Exactness requires |limb| <= 500 (2 * 500^2 * 32 < 2^24); callers with
     one-raw-level inputs (bound 680) must use ``mul(x, x)`` instead."""
-    batch = a.shape[1:]
+    batch_pad = [(0, 0)] * (a.ndim - 1)
     doubled = a + a
-    cols = jnp.zeros((2 * LIMBS - 1, *batch), dtype=jnp.float32)
+    terms = []
     for i in range(LIMBS):
         # Diagonal a_i^2 at column 2i, doubled cross terms a_i*a_j (j > i)
-        # at columns i+j — one fused row per i, positions 2i .. i+31.
+        # at columns i+j — one row per i, padded to the full 63 columns so
+        # the terms sum as a parallel reduction tree (a chained scatter-add
+        # would serialize all 32 updates).
         row = jnp.concatenate([a[i : i + 1] * a[i], doubled[i + 1 :] * a[i]], axis=0)
-        cols = cols.at[2 * i : i + LIMBS].add(row)
-    return _reduce_cols(cols)
+        terms.append(jnp.pad(row, [(2 * i, LIMBS - 1 - i)] + batch_pad))
+    return _reduce_cols(sum(terms))
 
 
 _P_LIMBS_I32 = np.array(

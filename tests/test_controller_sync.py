@@ -1,0 +1,273 @@
+"""Controller sync decision matrix unit tests with scripted collaborators.
+
+Parity model: reference internal/bft/controller_test.go sync cases — the
+matrix in controller.go:576-680: what the synchronizer returned (behind /
+ahead / empty) crossed with what the state-fetch collected (agreeing /
+failing / higher view).
+"""
+
+from consensus_tpu.core.controller import Controller
+from consensus_tpu.config import Configuration
+from consensus_tpu.core.batcher import Batcher
+from consensus_tpu.core.collector import StateCollector
+from consensus_tpu.core.pool import PoolOptions, RequestPool
+from consensus_tpu.core.state import InFlightData, PersistedState, ProposalMaker
+from consensus_tpu.runtime import SimScheduler
+from consensus_tpu.testing import MemWAL
+from consensus_tpu.testing.app import ByteInspector
+from consensus_tpu.testing.app import TestApp as PortsApp
+from consensus_tpu.types import Checkpoint, Decision, Proposal, Reconfig, SyncResponse
+from consensus_tpu.wire import (
+    StateTransferRequest,
+    StateTransferResponse,
+    ViewMetadata,
+    decode_saved,
+    encode_view_metadata,
+)
+
+NODES = (1, 2, 3, 4)
+
+
+def proposal_at(view, seq, decisions=0):
+    md = ViewMetadata(view_id=view, latest_sequence=seq, decisions_in_view=decisions)
+    return Proposal(payload=b"p%d" % seq, metadata=encode_view_metadata(md))
+
+
+class ScriptedSynchronizer:
+    def __init__(self):
+        self.response = SyncResponse(latest=None, reconfig=Reconfig())
+        self.calls = 0
+
+    def sync(self):
+        self.calls += 1
+        return self.response
+
+
+class RecordingVC:
+    def __init__(self):
+        self.informed = []
+
+    def handle_message(self, sender, msg):
+        pass
+
+    def handle_view_message(self, sender, msg):
+        pass
+
+    def start_view_change(self, view, stop_view):
+        pass
+
+    def inform_new_view(self, view):
+        self.informed.append(view)
+
+
+class Harness:
+    def __init__(self):
+        self.sched = SimScheduler()
+        self.cfg = Configuration(
+            self_id=2, leader_rotation=False, decisions_per_leader=0,
+            collect_timeout=1.0,
+        )
+        self.app = PortsApp(2, self)  # cluster duck-type below
+        self.nodes = {}
+        self.sent = []
+        self.vc = RecordingVC()
+        self.synchronizer = ScriptedSynchronizer()
+
+        class CommStub:
+            def __init__(self, outer):
+                self.outer = outer
+
+            def send_consensus(self, target, msg):
+                self.outer.sent.append((target, msg))
+
+            def send_transaction(self, target, raw):
+                pass
+
+            def nodes(self):
+                return NODES
+
+        in_flight = InFlightData()
+        self.wal = MemWAL([])
+        self.state = PersistedState(self.wal, in_flight, entries=[])
+        self.checkpoint = Checkpoint()
+        pool = RequestPool(self.sched, ByteInspector(), PoolOptions())
+        self.controller = Controller(
+            scheduler=self.sched,
+            config=self.cfg,
+            nodes=NODES,
+            comm=CommStub(self),
+            application=self.app,
+            assembler=self.app,
+            verifier=self.app,
+            signer=self.app,
+            synchronizer=self.synchronizer,
+            pool=pool,
+            batcher=Batcher(self.sched, pool, batch_max_count=10,
+                            batch_max_bytes=10**6, batch_max_interval=0.05),
+            leader_monitor=_MonitorStub(),
+            collector=StateCollector(self.sched, n=4, collect_timeout=1.0),
+            state=self.state,
+            in_flight=in_flight,
+            checkpoint=self.checkpoint,
+            proposer_builder=None,
+            view_changer=self.vc,
+        )
+        self.controller._proposer_builder = ProposalMaker(
+            state=self.state, view_factory=self._view_factory
+        )
+
+    # cluster duck-typing for TestApp
+    def longest_ledger(self, *, exclude):
+        return []
+
+    def reconfig_of(self, proposal):
+        return Reconfig()
+
+    def _view_factory(self, **kw):
+        from consensus_tpu.core.view import View
+
+        return View(
+            scheduler=self.sched, self_id=2, n=4, nodes=NODES,
+            comm=_ViewCommStub(self), verifier=self.app, signer=self.app,
+            state=self.state, decider=self.controller,
+            failure_detector=_FDStub(), sync_requester=self.controller,
+            checkpoint=self.checkpoint, decisions_per_leader=0, **kw,
+        )
+
+    def start(self, view=0, seq=1, dec=0):
+        self.controller.start(view, seq, dec)
+
+    def feed_state_responses(self, view, seq, senders=(1, 3)):
+        for sender in senders:
+            self.controller.process_message(
+                sender, StateTransferResponse(view_num=view, sequence=seq)
+            )
+
+
+class _MonitorStub:
+    def change_role(self, role, view, leader):
+        pass
+
+    def close(self):
+        pass
+
+    def process_msg(self, sender, msg):
+        pass
+
+    def inject_artificial_heartbeat(self, sender, msg):
+        pass
+
+    def heartbeat_was_sent(self):
+        pass
+
+
+class _ViewCommStub:
+    def __init__(self, outer):
+        self.outer = outer
+
+    def broadcast(self, msg):
+        pass
+
+    def send(self, target, msg):
+        pass
+
+
+class _FDStub:
+    def complain(self, view, stop_view):
+        pass
+
+
+def test_sync_broadcasts_state_transfer_request():
+    h = Harness()
+    h.start()
+    h.controller.sync()
+    h.sched.advance(0.1)
+    requests = [m for _, m in h.sent if isinstance(m, StateTransferRequest)]
+    assert len(requests) == 3  # all peers, not self
+    assert h.synchronizer.calls == 1
+
+
+def test_sync_advancing_checkpoint_moves_sequence():
+    # Synchronizer returns a decision ahead of us: checkpoint updates and
+    # the next view starts after it.
+    h = Harness()
+    h.start()
+    ahead = proposal_at(view=0, seq=5, decisions=4)
+    h.synchronizer.response = SyncResponse(latest=Decision(proposal=ahead))
+    h.controller.sync()
+    h.sched.advance(0.05)
+    h.feed_state_responses(view=0, seq=6)
+    h.sched.advance(2.0)
+    assert h.controller.latest_seq() == 5
+    assert h.controller.curr_view is not None
+    assert h.controller.curr_view.proposal_sequence == 6
+
+
+def test_sync_discovering_higher_view_informs_view_changer_and_saves_record():
+    # Peers agree the cluster is at view 3 one sequence past our latest
+    # decision: a NewView record is persisted and the VC is informed.
+    h = Harness()
+    h.start()
+    latest = proposal_at(view=0, seq=5, decisions=4)
+    h.synchronizer.response = SyncResponse(latest=Decision(proposal=latest))
+    h.controller.sync()
+    h.sched.advance(0.05)
+    h.feed_state_responses(view=3, seq=6)
+    h.sched.advance(2.0)
+    assert h.vc.informed == [3]
+    from consensus_tpu.wire import SavedNewView
+
+    saved = [decode_saved(e) for e in h.wal.entries]
+    new_views = [s for s in saved if isinstance(s, SavedNewView)]
+    assert new_views and new_views[-1].view_metadata.view_id == 3
+    assert h.controller.curr_view_number == 3
+
+
+def test_sync_timeout_with_nothing_new_restarts_current_view():
+    h = Harness()
+    h.start()
+    before_view = h.controller.curr_view_number
+    h.controller.sync()
+    h.sched.advance(3.0)  # collector times out, nothing learned
+    assert h.controller.curr_view_number == before_view
+    assert h.controller.curr_view is not None
+    assert not h.controller.curr_view.stopped
+
+
+def test_sync_is_idempotent_while_running():
+    h = Harness()
+    h.start()
+    h.controller.sync()
+    h.sched.advance(0.01)
+    h.controller.sync()  # second request while the first is collecting
+    h.sched.advance(0.01)
+    assert h.synchronizer.calls == 1
+
+
+def test_sync_reconfig_routes_to_callback():
+    seen = []
+    h = Harness()
+    h.controller._on_reconfig = seen.append
+    h.start()
+    h.synchronizer.response = SyncResponse(
+        latest=None, reconfig=Reconfig(in_latest_decision=True, current_nodes=(1, 2, 3))
+    )
+    h.controller.sync()
+    h.sched.advance(0.05)
+    assert len(seen) == 1 and seen[0].current_nodes == (1, 2, 3)
+
+
+def test_prune_in_flight_after_sync_past_it():
+    h = Harness()
+    h.start()
+    # An in-flight proposal at seq 5; sync returns a decision at seq 5.
+    h.controller.in_flight.store_proposal(proposal_at(view=0, seq=5))
+    assert h.controller.in_flight.proposal() is not None
+    h.synchronizer.response = SyncResponse(
+        latest=Decision(proposal=proposal_at(view=0, seq=5, decisions=1))
+    )
+    h.controller.sync()
+    h.sched.advance(0.05)
+    h.feed_state_responses(view=0, seq=6)
+    h.sched.advance(2.0)
+    assert h.controller.in_flight.proposal() is None

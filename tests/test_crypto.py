@@ -109,6 +109,32 @@ class TestField:
             v * v % fe.P for v in vals
         ]
 
+
+    def test_exactness_at_synthetic_limb_extremes(self):
+        # Drive mul/square at the DOCUMENTED limb bounds directly (random
+        # canonical inputs never reach them): raw-level operands at +-680 /
+        # -345..600 per limb, squaring at its 500 bound.
+        def arr(limb_values):
+            a = np.tile(np.array(limb_values, dtype=np.float32)[:, None], (1, 2))
+            return jnp.asarray(a)
+
+        def as_int(a):
+            col = np.asarray(a, dtype=np.int64)[:, 0]
+            return sum(int(col[i]) << (8 * i) for i in range(32))
+
+        hi = arr([680] * 32)                      # max add_raw output
+        lo = arr([-345, 600] * 16)                # extreme sub_raw output
+        want = (as_int(hi) * as_int(lo)) % fe.P
+        assert ints_of(fe.mul(hi, lo))[0] == want
+
+        sq_in = arr([500, -500] * 16)             # square() bound
+        want_sq = (as_int(sq_in) ** 2) % fe.P
+        assert ints_of(fe.square(sq_in))[0] == want_sq
+
+        # Reduction domain: _weak_reduce must handle the worst fold output.
+        big = arr([2**21] * 32)
+        assert ints_of(fe.add(big, big * 0))[0] == as_int(big) % fe.P
+
     def test_invert(self):
         vals = [3, 12345, fe.P - 2, 2**200 + 7]
         inv = fe.invert(limbs_of(vals))
