@@ -415,8 +415,7 @@ class Controller:
         Parity: reference controller.go:528-558 (decide) + 873-890 (Decide)
         + the MutuallyExclusiveDeliver guard (928-965)."""
         reconfig = self._deliver_checked(proposal, signatures)
-        for info in requests:
-            self.pool.remove_request(info)
+        self.pool.remove_requests(requests)
         self.curr_decisions_in_view += 1
 
         if reconfig.in_latest_decision:

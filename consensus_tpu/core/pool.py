@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 from collections import OrderedDict, deque
 from dataclasses import dataclass
-from typing import Callable, Optional, Protocol, Sequence
+from typing import Callable, Iterable, Optional, Protocol, Sequence
 
 from consensus_tpu.api.deps import RequestInspector
 from consensus_tpu.metrics import MetricsRequestPool, NoopProvider
@@ -277,10 +277,26 @@ class RequestPool:
 
         Parity: reference requestpool.go:357-401.
         """
-        removed = self._delete(info.key())
+        return self._delete(info.key())
+
+    def remove_requests(self, infos: Iterable[RequestInfo]) -> int:
+        """Bulk removal for a delivered batch: one parked-queue drain and
+        dedup GC for the whole batch instead of per request (the per-decision
+        hot path removes ``request_batch_max_count`` at once)."""
+        removed = sum(1 for info in infos if self._delete_entry(info.key()))
+        if removed:
+            self._gc_deleted()
+            self._drain_parked()
         return removed
 
     def _delete(self, key: str) -> bool:
+        if not self._delete_entry(key):
+            return False
+        self._gc_deleted()
+        self._drain_parked()
+        return True
+
+    def _delete_entry(self, key: str) -> bool:
         entry = self._fifo.pop(key, None)
         if entry is None:
             return False
@@ -291,8 +307,6 @@ class RequestPool:
         self._metrics.count_of_elements.set(len(self._fifo))
         self._metrics.latency_of_elements.observe(self._sched.now() - entry.arrived_at)
         self._deleted[key] = self._sched.now()
-        self._gc_deleted()
-        self._drain_parked()
         return True
 
     def _gc_deleted(self) -> None:

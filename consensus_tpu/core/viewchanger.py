@@ -87,6 +87,8 @@ class RequestsTimer(Protocol):
 
     def remove_request(self, info: RequestInfo) -> bool: ...
 
+    def remove_requests(self, infos) -> int: ...
+
 
 def validate_last_decision(
     vd: ViewData, quorum: int, verifier: Verifier
@@ -818,8 +820,9 @@ class ViewChanger:
             if self._on_reconfig is not None:
                 self._on_reconfig(reconfig)
             return
-        for info in self._verifier.requests_from_proposal(proposal):
-            self._requests_timer.remove_request(info)
+        self._requests_timer.remove_requests(
+            self._verifier.requests_from_proposal(proposal)
+        )
         self._controller.maybe_prune_revoked_requests()
 
     # --------------------------------------- in-flight re-commit (embedded)

@@ -340,6 +340,9 @@ class _VCStubTimer:
     def remove_request(self, info):
         return True
 
+    def remove_requests(self, infos):
+        return 0
+
 
 class _VCComm:
     def __init__(self):
