@@ -283,18 +283,29 @@ class RequestPool:
         """Bulk removal for a delivered batch: one parked-queue drain and
         dedup GC for the whole batch instead of per request (the per-decision
         hot path removes ``request_batch_max_count`` at once)."""
-        removed = sum(1 for info in infos if self._delete_entry(info.key()))
-        if removed:
-            self._gc_deleted()
-            self._drain_parked()
+        removed = 0
+        now = self._sched.now()
+        for info in infos:
+            key = info.key()
+            if self._delete_entry(key):
+                removed += 1
+            else:
+                # Delivered but not pooled here (e.g. still parked): mark it
+                # recently-deleted anyway so the trailing drain cannot
+                # re-admit a copy of an already-committed request.
+                self._deleted[key] = now
+        self._gc_deleted()
+        self._drain_parked()
         return removed
 
     def _delete(self, key: str) -> bool:
-        if not self._delete_entry(key):
-            return False
+        present = self._delete_entry(key)
+        if not present:
+            # Same delivered-while-parked guard as the bulk path.
+            self._deleted[key] = self._sched.now()
         self._gc_deleted()
         self._drain_parked()
-        return True
+        return present
 
     def _delete_entry(self, key: str) -> bool:
         entry = self._fifo.pop(key, None)
@@ -326,7 +337,7 @@ class RequestPool:
         doomed = [e.info for e in self._fifo.values() if not keep(e.raw)]
         for info in doomed:
             logger.info("pruning request %s (failed re-validation)", info)
-            self._delete(info.key())
+        self.remove_requests(doomed)
 
     def change_options(
         self,

@@ -54,16 +54,25 @@ class Proposal:
     verification_sequence: int = 0
 
     def digest(self) -> str:
-        """Deterministic content digest (hex).
+        """Deterministic content digest (hex), cached per instance — the hot
+        protocol paths (prepare/commit digest matching, WAL records) call
+        this repeatedly on the same immutable proposal.
 
         Parity: reference pkg/types/types.go:50-62 (ASN.1+SHA-256 there).
         """
+        cached = getattr(self, "_digest_cache", None)
+        if cached is not None:
+            return cached
         h = hashlib.sha256()
         h.update(struct.pack(">Q", self.verification_sequence))
         h.update(_lp(self.header))
         h.update(_lp(self.payload))
         h.update(_lp(self.metadata))
-        return h.hexdigest()
+        value = h.hexdigest()
+        # Frozen dataclass: bypass the immutability guard for the memo only
+        # (not a field — equality/repr/replace are unaffected).
+        object.__setattr__(self, "_digest_cache", value)
+        return value
 
 
 @dataclass(frozen=True)

@@ -247,3 +247,27 @@ def test_pool_close_fails_parked_submissions():
     assert results == ["pool closed"]
     pool.submit(req(3), results.append)
     assert results[-1] == "pool closed"
+
+
+def test_delivered_while_parked_request_not_readmitted():
+    # A request parked behind a full pool gets delivered via the leader in
+    # the meantime: the batch removal must block its re-admission (else it
+    # lingers forever and its cascade triggers a spurious complaint).
+    s = SimScheduler()
+    pool, handler = make_pool(s, pool_size=2, submit_timeout=30.0)
+    pool.submit(req(1))
+    pool.submit(req(2))
+    parked = []
+    pool.submit(req(3), parked.append)  # parked: pool is full
+    assert parked == []
+
+    # The leader's batch [1, 2, 3] commits; all three are removed — 3 was
+    # never admitted here but must still be blocked from re-admission.
+    removed = pool.remove_requests(
+        [RequestInfo("c", "1"), RequestInfo("c", "2"), RequestInfo("c", "3")]
+    )
+    assert removed == 2
+    assert parked == ["request already exists"]
+    assert pool.count == 0
+    s.advance(100.0)
+    assert handler.events == [], "stale parked request fired its cascade"

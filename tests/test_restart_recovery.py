@@ -159,3 +159,49 @@ def test_whole_cluster_restart_resumes_ordering():
         cluster.submit_to_all(make_request("c", i))
         assert cluster.run_until_ledger(i + 1, max_time=300.0), f"block {i} stalled after restart"
     cluster.assert_ledgers_consistent()
+
+
+def test_restart_during_view_change_rejoins_it():
+    # A replica that crashes after voting to change views must, on restart,
+    # restore the pending ViewChange from its WAL and rejoin (reference
+    # consensus.go:464-504 + the viewchanger Restore path).
+    FAST = {
+        "request_forward_timeout": 1.0,
+        "request_complain_timeout": 4.0,
+        "request_auto_remove_timeout": 60.0,
+        "view_change_resend_interval": 2.0,
+        "view_change_timeout": 10.0,
+    }
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    # Kill the leader; let complaints fire and the view change start.
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 1))
+
+    # Wait for node 4 to *persist* its ViewChange vote (the join step, which
+    # happens once quorum-1 peers voted), then crash it mid-change.
+    from consensus_tpu.wire import SavedViewChange, decode_saved
+
+    def vote_saved():
+        return any(
+            isinstance(decode_saved(e), SavedViewChange)
+            for e in cluster.nodes[4].wal_backing
+        )
+
+    assert cluster.scheduler.run_until(vote_saved, max_time=120.0), (
+        "view-change vote never persisted"
+    )
+    cluster.nodes[4].crash()
+    cluster.scheduler.advance(1.0)
+    cluster.nodes[4].restart()
+
+    # The restarted node rejoins the change; with it back, 3 of 4 are alive
+    # and the new view must order the pending request.
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=600.0), (
+        "restarted node failed to rejoin the view change"
+    )
+    cluster.assert_ledgers_consistent()
+    assert cluster.nodes[4].consensus.controller.curr_view_number >= 1
