@@ -292,7 +292,10 @@ class RequestPool:
             else:
                 # Delivered but not pooled here (e.g. still parked): mark it
                 # recently-deleted anyway so the trailing drain cannot
-                # re-admit a copy of an already-committed request.
+                # re-admit a copy of an already-committed request.  Pop
+                # first: a refresh must move to the end, or the GC's
+                # stop-at-first-fresh scan retains expired entries behind it.
+                self._deleted.pop(key, None)
                 self._deleted[key] = now
         self._gc_deleted()
         self._drain_parked()
@@ -301,7 +304,9 @@ class RequestPool:
     def _delete(self, key: str) -> bool:
         present = self._delete_entry(key)
         if not present:
-            # Same delivered-while-parked guard as the bulk path.
+            # Same delivered-while-parked guard as the bulk path (pop first
+            # to keep the OrderedDict in timestamp order for the GC).
+            self._deleted.pop(key, None)
             self._deleted[key] = self._sched.now()
         self._gc_deleted()
         self._drain_parked()

@@ -271,3 +271,21 @@ def test_delivered_while_parked_request_not_readmitted():
     assert pool.count == 0
     s.advance(100.0)
     assert handler.events == [], "stale parked request fired its cascade"
+
+
+def test_deleted_refresh_keeps_gc_order():
+    # Refreshing a dedup entry must move it to the back of the retention
+    # queue, or the GC's stop-at-first-fresh scan strands expired entries.
+    s = SimScheduler()
+    pool, _ = make_pool(s, pool_size=4, auto_remove_timeout=1000.0)
+    pool.submit(req(1))
+    pool.remove_request(RequestInfo("c", "1"))  # deleted at t=0
+    s.advance(3.0)
+    pool.submit(req(2))
+    pool.remove_request(RequestInfo("c", "2"))  # deleted at t=3
+    s.advance(1.0)
+    pool.remove_request(RequestInfo("c", "1"))  # refresh at t=4 (absent key)
+    s.advance(4.5)  # t=8.5: entry 2 (t=3) is expired, entry 1 (t=4) is not
+    results = []
+    pool.submit(req(2), results.append)  # triggers GC; 2 must be admittable
+    assert results == [None], f"expired dedup entry was retained: {results}"
