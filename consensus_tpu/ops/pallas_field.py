@@ -39,34 +39,16 @@ def _mul_kernel(a_ref, b_ref, out_ref):
     a = a_ref[:, :]
     b = b_ref[:, :]
 
-    # Schoolbook convolution into 63 columns.
+    # Schoolbook convolution into 63 columns (i is a trace-time constant,
+    # so each accumulate is a static overlapping-window update).
     cols = jnp.zeros((2 * fe.LIMBS - 1, a.shape[1]), dtype=jnp.float32)
     for i in range(fe.LIMBS):
-        cols = jax.lax.dynamic_update_slice(
-            cols,
-            jax.lax.dynamic_slice(cols, (i, 0), (fe.LIMBS, a.shape[1]))
-            + a[i] * b,
-            (i, 0),
-        )
+        cols = cols.at[i : i + fe.LIMBS].add(a[i] * b)
 
-    # Carry-save split + fold of weights >= 2^256 (38) — mirrors
-    # field25519._reduce_cols.
-    hi = jnp.floor(cols * fe.INV_BASE)
-    lo = cols - hi * fe.BASE
-    c = jnp.concatenate([lo[:1], lo[1:] + hi[:-1], hi[-1:]], axis=0)
-    r = c[: fe.LIMBS] + c[fe.LIMBS :] * fe.FOLD
-
-    # Three relax passes + top fold (field25519._weak_reduce).
-    for _ in range(3):
-        hi = jnp.floor(r * fe.INV_BASE)
-        lo = r - hi * fe.BASE
-        r = lo + jnp.concatenate([hi[31:] * fe.FOLD, hi[:31]], axis=0)
-    high = jnp.floor(r[31] * (1.0 / 128.0))
-    r = jnp.concatenate(
-        [(r[0] + high * fe.TOP_FOLD)[None], r[1:31], (r[31] - high * 128.0)[None]],
-        axis=0,
-    )
-    out_ref[:, :] = r
+    # The fold + weak reduction are the shared jnp helpers — they trace
+    # inside the kernel, so the opt-in path can never diverge from the
+    # default one.
+    out_ref[:, :] = fe._reduce_cols(cols)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
