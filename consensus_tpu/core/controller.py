@@ -559,6 +559,23 @@ class Controller:
             self._verification_sequence = latest.proposal.verification_sequence
             new_seq = latest_md.latest_sequence + 1
             new_decisions = latest_md.decisions_in_view + 1
+        elif (
+            latest_md is not None
+            and latest_md.latest_sequence == controller_seq
+            and latest_md.view_id == self.curr_view_number
+        ):
+            # We already hold this view's latest decision: carry its
+            # decisions-in-view forward.  When our counter is already right,
+            # change_view's early-return makes this a no-op; when a
+            # late-processed NewView reset it to 0 while the cluster kept
+            # deciding, this repairs it — otherwise every future proposal is
+            # rejected ("decisions-in-view N != 0") forever.
+            new_decisions = latest_md.decisions_in_view + 1
+            if new_decisions != self.curr_decisions_in_view:
+                logger.info(
+                    "%d: repairing decisions-in-view %d -> %d from checkpoint",
+                    self.id, self.curr_decisions_in_view, new_decisions,
+                )
         if latest_md is not None and latest_md.view_id > self.curr_view_number:
             new_view = latest_md.view_id
 

@@ -271,3 +271,39 @@ def test_prune_in_flight_after_sync_past_it():
     h.feed_state_responses(view=0, seq=6)
     h.sched.advance(2.0)
     assert h.controller.in_flight.proposal() is None
+
+
+def test_sync_repairs_stale_decisions_in_view():
+    # A late-processed NewView can reset decisions-in-view to 0 while the
+    # cluster kept deciding in the same view; the node then rejects every
+    # proposal ("decisions-in-view N != 0") forever. Sync must repair the
+    # counter from the checkpoint's own metadata even when the sequence has
+    # not advanced.
+    h = Harness()
+    h.start(view=0, seq=6, dec=0)  # wrong: the view has decided 3 times
+    latest = proposal_at(view=0, seq=5, decisions=2)
+    h.checkpoint.set(latest, ())
+    h.synchronizer.response = SyncResponse(latest=Decision(proposal=latest))
+    h.controller.sync()
+    h.sched.advance(0.05)
+    h.feed_state_responses(view=0, seq=6)
+    h.sched.advance(2.0)
+    assert h.controller.curr_decisions_in_view == 3
+    assert h.controller.curr_view_number == 0
+    assert h.controller.curr_view.proposal_sequence == 6
+
+
+def test_sync_does_not_clobber_fresh_view_decisions():
+    # Fresh view after a view change: the latest decision belongs to an
+    # OLDER view, so decisions-in-view legitimately starts at 0 and must
+    # not be "repaired" from stale metadata.
+    h = Harness()
+    latest = proposal_at(view=0, seq=5, decisions=2)
+    h.checkpoint.set(latest, ())
+    h.controller.start(2, 6, 0)  # new view 2, decisions correctly 0
+    h.synchronizer.response = SyncResponse(latest=Decision(proposal=latest))
+    h.controller.sync()
+    h.sched.advance(0.05)
+    h.feed_state_responses(view=2, seq=6)
+    h.sched.advance(2.0)
+    assert h.controller.curr_decisions_in_view == 0

@@ -202,3 +202,86 @@ def test_non_tail_corruption_refuses_auto_repair(tmp_path):
     open(mid, "wb").write(bytes(buf))
     with pytest.raises(WALError):
         repair(d)
+
+
+def test_group_commit_batches_fsyncs_and_fires_callbacks(tmp_path):
+    from unittest import mock
+
+    from consensus_tpu.runtime import SimScheduler
+
+    s = SimScheduler()
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog.create(d, group_commit_window=0.002, scheduler=s)
+    durable = []
+    with mock.patch("os.fsync") as fsync:
+        fsync.reset_mock()
+        for i in range(10):
+            wal.append(b"e%d" % i, on_durable=lambda i=i: durable.append(i))
+        assert durable == []  # nothing durable before the window closes
+        group_syncs_before = fsync.call_count
+        s.advance(0.002)
+        # One fsync covered all ten appends.
+        assert fsync.call_count == group_syncs_before + 1
+    assert durable == list(range(10))
+    # Records are intact and readable.
+    assert wal.read_all() == [b"e%d" % i for i in range(10)]
+    wal.close()
+
+
+def test_group_commit_close_flushes_pending(tmp_path):
+    from consensus_tpu.runtime import SimScheduler
+
+    s = SimScheduler()
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog.create(d, group_commit_window=1.0, scheduler=s)
+    durable = []
+    wal.append(b"x", on_durable=lambda: durable.append("x"))
+    wal.close()  # window never elapsed: close must make it durable
+    assert durable == ["x"]
+    assert WriteAheadLog.open_(d).read_all() == [b"x"]
+
+
+def test_default_mode_callback_fires_synchronously(tmp_path):
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog.create(d)
+    durable = []
+    wal.append(b"x", on_durable=lambda: durable.append("x"))
+    assert durable == ["x"]
+    wal.close()
+
+
+def test_group_commit_truncate_flushes_before_dropping_history(tmp_path):
+    from unittest import mock
+
+    from consensus_tpu.runtime import SimScheduler
+
+    s = SimScheduler()
+    d = str(tmp_path / "wal")
+    wal = WriteAheadLog.create(d, segment_max_bytes=200,
+                               group_commit_window=1.0, scheduler=s)
+    for e in entries_of(12, size=16):
+        wal.append(e)
+    calls = []
+    real_fsync = os.fsync
+    with mock.patch("os.fsync", side_effect=lambda fd: (calls.append("fsync"), real_fsync(fd))):
+        with mock.patch("os.unlink", side_effect=lambda p: calls.append("unlink")):
+            wal.append(b"restore-point", truncate_to=True)
+    assert "fsync" in calls and "unlink" in calls
+    assert calls.index("fsync") < calls.index("unlink"), (
+        "history deleted before the restore point was durable"
+    )
+    wal.close()
+
+
+def test_group_commit_config_validation(tmp_path):
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        WriteAheadLog(str(tmp_path / "a"), group_commit_window=0.1)
+    with _pytest.raises(ValueError):
+        WriteAheadLog(str(tmp_path / "b"), group_commit_window=0.1,
+                      scheduler=object(), sync=False)
+    d = str(tmp_path / "c")
+    wal = WriteAheadLog.create(d, sync=False)
+    with _pytest.raises(WALError):
+        wal.append(b"x", on_durable=lambda: None)
