@@ -68,11 +68,17 @@ class Assembler(abc.ABC):
 class WriteAheadLog(abc.ABC):
     """Persistence for protocol step records (crash recovery).
 
-    Parity: reference pkg/api/dependencies.go:40-44.
+    ``on_durable`` (when given) must fire once the entry is on stable
+    storage; implementations that fsync synchronously call it before
+    returning, group-commit implementations defer it to the batched fsync.
+    Parity: reference pkg/api/dependencies.go:40-44 (callback is ours — the
+    seam that lets the protocol defer sends under group commit).
     """
 
     @abc.abstractmethod
-    def append(self, entry: bytes, truncate_to: bool = False) -> None: ...
+    def append(
+        self, entry: bytes, truncate_to: bool = False, on_durable=None
+    ) -> None: ...
 
 
 class Signer(abc.ABC):

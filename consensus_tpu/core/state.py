@@ -84,15 +84,22 @@ class PersistedState:
 
     # --- saving ------------------------------------------------------------
 
-    def save(self, record: SavedMessage) -> None:
-        """Persist one protocol step.  A new ProposedRecord doubles as a
-        truncation point: the previous proposal is then stably decided
-        (reference state.go:38-59)."""
+    def save(self, record: SavedMessage, on_durable=None) -> None:
+        """Persist one protocol step; ``on_durable`` fires once the record
+        is on stable storage (immediately for per-append fsync, deferred
+        under group commit — the protocol defers its sends behind it).
+
+        A new ProposedRecord doubles as a truncation point: the previous
+        proposal is then stably decided (reference state.go:38-59)."""
         if isinstance(record, ProposedRecord):
             self._in_flight.store_proposal(record.pre_prepare.proposal)
         elif isinstance(record, SavedCommit):
             self._in_flight.store_prepared(record.commit.view, record.commit.seq)
-        self._wal.append(encode_saved(record), truncate_to=isinstance(record, ProposedRecord))
+        self._wal.append(
+            encode_saved(record),
+            truncate_to=isinstance(record, ProposedRecord),
+            on_durable=on_durable,
+        )
 
     # --- boot-time peeking (pkg/consensus setViewAndSeq equivalents) -------
 

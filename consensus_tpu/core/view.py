@@ -342,24 +342,33 @@ class View:
         prepare = Prepare(
             view=self.number, seq=self.proposal_sequence, digest=proposal.digest()
         )
-        # WAL before send: we must remember having prepared before anyone
-        # hears about it (view.go:404-414).
-        self._state.save(ProposedRecord(pre_prepare=pp, prepare=prepare))
+
+        def send_after_durable() -> None:
+            # WAL before send: we must remember having prepared before
+            # anyone hears about it (view.go:404-414).  Under group commit
+            # this fires from the batched fsync; default mode fires inline.
+            # The assist copy is also only armed here — retransmission help
+            # must never reveal an un-persisted message either.
+            if self.stopped:
+                return
+            self._curr_prepare_sent = Prepare(
+                view=prepare.view, seq=prepare.seq, digest=prepare.digest, assist=True
+            )
+            if self.self_id == self.leader_id:
+                # Only now does the leader reveal the proposal to the others.
+                self._comm.broadcast(pp)
+            self._comm.broadcast(prepare)
 
         self.in_flight_proposal = proposal
         self.in_flight_requests = tuple(requests)
         self.metrics.count_txs_in_batch.set(len(requests))
         self._begin_pre_prepare = self._sched.now()
-        self._curr_prepare_sent = Prepare(
-            view=prepare.view, seq=prepare.seq, digest=prepare.digest, assist=True
-        )
         self.phase = Phase.PROPOSED
         self.metrics.phase.set(int(self.phase))
-
-        if self.self_id == self.leader_id:
-            # Only now does the leader reveal the proposal to the others.
-            self._comm.broadcast(pp)
-        self._comm.broadcast(prepare)
+        self._state.save(
+            ProposedRecord(pre_prepare=pp, prepare=prepare),
+            on_durable=send_after_durable,
+        )
         logger.info("%d: proposed seq %d in view %d", self.self_id, prepare.seq, self.number)
 
     # --- PROPOSED -> PREPARED (view.go:441-517) ----------------------------
@@ -381,18 +390,23 @@ class View:
             digest=expected,
             signature=self.my_commit_signature,
         )
-        # WAL before send again: the commit we are about to utter.
-        self._state.save(SavedCommit(commit=commit))
-        self._curr_commit_sent = Commit(
-            view=commit.view,
-            seq=commit.seq,
-            digest=commit.digest,
-            signature=commit.signature,
-            assist=True,
-        )
+
+        def send_after_durable() -> None:
+            if self.stopped:
+                return
+            self._curr_commit_sent = Commit(
+                view=commit.view,
+                seq=commit.seq,
+                digest=commit.digest,
+                signature=commit.signature,
+                assist=True,
+            )
+            self._comm.broadcast(commit)
+
         self.phase = Phase.PREPARED
         self.metrics.phase.set(int(self.phase))
-        self._comm.broadcast(commit)
+        # WAL before send again: the commit we are about to utter.
+        self._state.save(SavedCommit(commit=commit), on_durable=send_after_durable)
         logger.info("%d: prepared seq %d (%d prepares)", self.self_id, commit.seq, len(voters))
 
     # --- PREPARED -> decide (view.go:519-551, batched) ---------------------

@@ -215,6 +215,13 @@ class _NextViews:
         self._helped.add(key)
         return True
 
+    def views_above(self, view: int) -> list[int]:
+        """Views > ``view`` with at least one registered voter, ascending."""
+        return sorted(v for v, senders in self._votes.items() if v > view and senders)
+
+    def voters_of(self, view: int) -> set[int]:
+        return set(self._votes.get(view, ()))
+
     def clear(self) -> None:
         self._votes.clear()
         self._helped.clear()
@@ -286,6 +293,7 @@ class ViewChanger:
 
         self._in_flight_view: Optional[View] = None
         self._pending_transition = False
+        self._pending_join_target: Optional[int] = None
 
         self._timer: Optional[TimerHandle] = None
         self._stopped = True
@@ -470,10 +478,45 @@ class ViewChanger:
             # Help lagging nodes converge on the earlier view change.
             self._comm.broadcast(ViewChange(next_view=vc.next_view))
             return
+        if self._maybe_jump_ahead():
+            return
         logger.debug(
             "%d: view change to %d from %d ignored (expecting %d)",
             self.self_id, vc.next_view, sender, self.curr_view + 1,
         )
+
+    def _maybe_jump_ahead(self) -> bool:
+        """PBFT liveness rule: f+1 distinct nodes voting for views beyond
+        our next prove at least one honest replica is ahead — adopt the
+        SMALLEST such view, so diverged next-views re-converge instead of
+        each replica escalating alone (a stall the randomized soak found:
+        next-views 6/15/15/16 with no quorum possible for any of them)."""
+        views_ahead = self._nvs.views_above(self.next_view)
+        if not views_ahead:
+            return False
+        senders_ahead: set[int] = set()
+        for view in views_ahead:
+            senders_ahead |= self._nvs.voters_of(view)
+        senders_ahead.discard(self.self_id)
+        if len(senders_ahead) < self.f + 1:
+            return False
+        target = views_ahead[0]
+        logger.info(
+            "%d: %d nodes vote for views beyond %d — jumping to view change %d",
+            self.self_id, len(senders_ahead), self.next_view, target,
+        )
+        self.curr_view = target - 1
+        self.next_view = self.curr_view  # start_view_change bumps to target
+        self._update_view_gauges()
+        self._view_change_votes = {}  # all stale: they were for an older view+1
+        self._view_data_votes = {}
+        self.start_view_change(self.curr_view, stop_view=True)
+        # Count any already-registered votes for the target view.
+        for voter in self._nvs.voters_of(target):
+            if voter != self.self_id:
+                self._view_change_votes.setdefault(voter, ViewChange(next_view=target))
+        self._process_view_change_votes(restore=False)
+        return True
 
     def _process_view_change_votes(self, *, restore: bool) -> None:
         """Join + advance rules.  Parity: reference viewchanger.go:393-431.
@@ -488,22 +531,43 @@ class ViewChanger:
             return
         if not self._speed_up:
             self.start_view_change(self.curr_view, stop_view=True)
-        if not restore:
-            self._state.save(
-                SavedViewChange(view_change=ViewChange(next_view=self.curr_view))
-            )
-        self._controller.abort_view(self.curr_view)
-        self.curr_view = self.next_view
-        self._update_view_gauges()
-        self._view_change_votes = {}
-        self._view_data_votes = {}
-        svd = self._prepare_view_data()
-        leader = self._get_leader()
-        if leader == self.self_id:
-            self._view_data_votes[self.self_id] = svd
-            self._process_view_data_votes()
+        # Snapshot the transition: under group commit the fsync window can
+        # overlap state changes (inform_new_view, a jump, another quorum) —
+        # the deferred continuation must no-op if it is no longer current,
+        # and must not run twice for the same target.
+        target = self.next_view
+        prior_view = self.curr_view
+        if self._pending_join_target == target and not restore:
+            return
+        self._pending_join_target = target
+
+        def continue_after_durable() -> None:
+            if self._pending_join_target == target:
+                self._pending_join_target = None
+            if self._stopped:
+                return
+            if self.curr_view != prior_view or self.next_view != target:
+                return  # superseded while awaiting durability
+            self._controller.abort_view(prior_view)
+            self.curr_view = target
+            self._update_view_gauges()
+            self._view_change_votes = {}
+            self._view_data_votes = {}
+            svd = self._prepare_view_data()
+            leader = self._get_leader()
+            if leader == self.self_id:
+                self._view_data_votes[self.self_id] = svd
+                self._process_view_data_votes()
+            else:
+                self._comm.send(leader, svd)
+
+        if restore:
+            continue_after_durable()  # the vote is already in the WAL
         else:
-            self._comm.send(leader, svd)
+            self._state.save(
+                SavedViewChange(view_change=ViewChange(next_view=prior_view)),
+                on_durable=continue_after_durable,
+            )
 
     def _prepare_view_data(self) -> SignedViewData:
         """Parity: reference viewchanger.go:433-456."""

@@ -79,10 +79,12 @@ class MemWAL(WriteAheadLog):
     def __init__(self, backing: list[bytes]) -> None:
         self._backing = backing
 
-    def append(self, entry: bytes, truncate_to: bool = False) -> None:
+    def append(self, entry: bytes, truncate_to: bool = False, on_durable=None) -> None:
         if truncate_to:
             self._backing.clear()
         self._backing.append(entry)
+        if on_durable is not None:
+            on_durable()  # memory-backed: "durable" immediately
 
     @property
     def entries(self) -> list[bytes]:

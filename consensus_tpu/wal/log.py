@@ -30,11 +30,14 @@ Payload = 1 type byte (ENTRY / ANCHOR) + 1 flag byte (truncate_to) + data.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 import struct
 import zlib
 from typing import Optional
+
+logger = logging.getLogger("consensus_tpu.wal")
 
 _HEADER = struct.Struct("<II")
 _TYPE_ENTRY = 0x01
@@ -126,6 +129,7 @@ class WriteAheadLog:
         self._group_window = group_commit_window
         self._scheduler = scheduler
         self._sync_pending = False
+        self._sync_timer = None
         self._sync_waiters: list = []
         self._file: Optional[object] = None  # io.BufferedWriter
         self._segment_index = 0
@@ -172,8 +176,14 @@ class WriteAheadLog:
         return wal
 
     def close(self) -> None:
+        if self._sync_timer is not None:
+            self._sync_timer.cancel()
+            self._sync_timer = None
         if self._sync_waiters or self._sync_pending:
-            self.flush_group()
+            if not self.flush_group():
+                raise WALError(
+                    "close: pending records could not be made durable"
+                )
         if self._file is not None:
             self._file.flush()
             self._file.close()
@@ -200,29 +210,66 @@ class WriteAheadLog:
             raise WALError("on_durable requires a sync-enabled log")
         flags = _FLAG_TRUNCATE_TO if truncate_to else 0
         self._write_record(_TYPE_ENTRY, flags, data)
+        if on_durable is not None and self._group_window:
+            # Queue BEFORE any eager flush below, so a truncate-triggered
+            # flush covers this record's callback too.
+            self._sync_waiters.append(on_durable)
         if truncate_to:
             if self._group_window:
                 # The restore point must be durable BEFORE the history it
                 # replaces is deleted, or a crash in the window loses both.
-                self.flush_group()
-            self._drop_old_segments()
+                # On fsync failure the deletion rides the retry queue.
+                if self.flush_group():
+                    self._drop_old_segments()
+                else:
+                    self._sync_waiters.append(self._drop_old_segments)
+            else:
+                self._drop_old_segments()
         if self._file.tell() >= self._segment_max_bytes:
             self._start_segment(self._segment_index + 1)
-        if on_durable is not None:
-            if self._group_window:
-                self._sync_waiters.append(on_durable)
-            else:
-                on_durable()  # already fsynced synchronously
+        if on_durable is not None and not self._group_window:
+            on_durable()  # already fsynced synchronously
 
-    def flush_group(self) -> None:
-        """Fsync now and complete every deferred durability callback."""
+    def flush_group(self) -> bool:
+        """Fsync now and complete every deferred durability callback;
+        returns whether durability was actually achieved.
+
+        An fsync failure (ENOSPC/EIO) keeps the waiters queued and retries
+        after another window — records must never be reported durable when
+        they are not, and the error must not strand the queue silently."""
+        if self._sync_timer is not None:
+            self._sync_timer.cancel()
+            self._sync_timer = None
+        if self._file is None:
+            # Closed (or never opened) with work still queued: durability is
+            # unachievable — never fire the callbacks as if it happened.
+            return not self._sync_waiters
+        if self._sync:
+            try:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+            except OSError:
+                logger.exception(
+                    "WAL group fsync failed; retrying in %.3fs",
+                    self._group_window or 0.05,
+                )
+                if self._scheduler is not None:
+                    self._sync_pending = True
+                    self._sync_timer = self._scheduler.call_later(
+                        self._group_window or 0.05,
+                        self.flush_group,
+                        name="wal-group-commit-retry",
+                    )
+                    return False
+                raise
         self._sync_pending = False
-        if self._file is not None and self._sync:
-            self._file.flush()
-            os.fsync(self._file.fileno())
         waiters, self._sync_waiters = self._sync_waiters, []
         for waiter in waiters:
-            waiter()
+            try:
+                waiter()
+            except Exception:
+                logger.exception("on_durable callback failed; continuing with the rest")
+        return True
 
     def _write_record(self, rtype: int, flags: int, data: bytes) -> None:
         payload = bytes([rtype, flags]) + data
@@ -238,7 +285,7 @@ class WriteAheadLog:
                 # (constructor guarantees a scheduler exists).
                 if not self._sync_pending:
                     self._sync_pending = True
-                    self._scheduler.call_later(
+                    self._sync_timer = self._scheduler.call_later(
                         self._group_window, self.flush_group, name="wal-group-commit"
                     )
             else:

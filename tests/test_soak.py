@@ -8,6 +8,8 @@ test/basic_test.go, compressed into deterministic virtual time.
 
 import random
 
+import pytest
+
 from consensus_tpu.testing import Cluster, make_request
 
 FAST = {
@@ -20,8 +22,9 @@ FAST = {
 }
 
 
-def test_randomized_fault_soak():
-    rng = random.Random(20260728)
+@pytest.mark.parametrize("seed", [20260728, 8, 17, 33])
+def test_randomized_fault_soak(seed):
+    rng = random.Random(seed)
     cluster = Cluster(4, seed=11, config_tweaks=FAST)
     cluster.start()
     submitted = 0
@@ -83,3 +86,56 @@ def test_randomized_fault_soak():
     cluster.assert_ledgers_consistent()
     # Sanity: a meaningful amount of work actually got ordered during chaos.
     assert floor >= 5, f"only {floor} blocks ordered across the soak"
+
+
+def test_randomized_fault_soak_n7_two_faults():
+    # f=2 cluster: tolerate two simultaneous crashed replicas while the
+    # chaos schedule churns membership of the live set.
+    rng = random.Random(777)
+    cluster = Cluster(7, seed=3, config_tweaks=FAST)
+    cluster.start()
+    submitted = 0
+    crashed: set[int] = set()
+
+    def submit_some(k=3):
+        nonlocal submitted
+        for _ in range(k):
+            cluster.submit_to_all(make_request("soak7", submitted))
+            submitted += 1
+
+    submit_some(5)
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    for step in range(20):
+        roll = rng.random()
+        if roll < 0.3 and len(crashed) < 2:
+            victim = rng.choice([n for n in cluster.nodes if n not in crashed])
+            cluster.nodes[victim].crash()
+            crashed.add(victim)
+        elif roll < 0.55 and crashed:
+            node_id = crashed.pop()
+            cluster.nodes[node_id].restart()
+        elif roll < 0.7:
+            a, b = rng.sample(list(cluster.nodes), 2)
+            cluster.network.set_loss(a, b, 0.2)
+        else:
+            cluster.network.heal()
+        submit_some(rng.randrange(1, 4))
+        cluster.scheduler.advance(rng.uniform(5.0, 30.0))
+        cluster.assert_ledgers_consistent()
+
+    cluster.network.heal()
+    for node_id in list(crashed):
+        cluster.nodes[node_id].restart()
+        crashed.discard(node_id)
+    cluster.scheduler.advance(60.0)
+    floor = max(len(n.app.ledger) for n in cluster.nodes.values())
+    submit_some(5)
+    assert cluster.scheduler.run_until(
+        lambda: sum(
+            1 for n in cluster.nodes.values()
+            if len(n.app.ledger) >= floor + 1
+        ) >= 5,
+        max_time=900.0,
+    ), "n=7 cluster failed to make progress after healing"
+    cluster.assert_ledgers_consistent()

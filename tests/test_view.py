@@ -93,8 +93,10 @@ class FakeState:
     def __init__(self):
         self.saved = []
 
-    def save(self, record):
+    def save(self, record, on_durable=None):
         self.saved.append(record)
+        if on_durable is not None:
+            on_durable()  # per-append fsync semantics
 
 
 class FakeDecider:

@@ -285,3 +285,103 @@ def test_group_commit_config_validation(tmp_path):
     wal = WriteAheadLog.create(d, sync=False)
     with _pytest.raises(WALError):
         wal.append(b"x", on_durable=lambda: None)
+
+
+def test_group_commit_waiter_exception_does_not_strand_others(tmp_path):
+    from consensus_tpu.runtime import SimScheduler
+
+    s = SimScheduler()
+    wal = WriteAheadLog.create(str(tmp_path / "wal"),
+                               group_commit_window=0.01, scheduler=s)
+    fired = []
+    wal.append(b"a", on_durable=lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    wal.append(b"b", on_durable=lambda: fired.append("b"))
+    wal.append(b"c", on_durable=lambda: fired.append("c"))
+    s.advance(0.01)
+    assert fired == ["b", "c"]
+    wal.close()
+
+
+def test_group_commit_truncate_cancels_stale_timer(tmp_path):
+    from unittest import mock
+
+    from consensus_tpu.runtime import SimScheduler
+
+    s = SimScheduler()
+    wal = WriteAheadLog.create(str(tmp_path / "wal"),
+                               group_commit_window=0.01, scheduler=s)
+    wal.append(b"x")
+    wal.append(b"checkpoint", truncate_to=True)  # eager flush cancels timer
+    real_fsync = os.fsync
+    with mock.patch("os.fsync", side_effect=real_fsync) as fsync:
+        s.advance(0.05)  # the stale timer must NOT fire an extra fsync
+        assert fsync.call_count == 0
+    wal.close()
+
+
+def test_group_commit_fsync_failure_retries_without_false_durability(tmp_path):
+    from unittest import mock
+
+    from consensus_tpu.runtime import SimScheduler
+
+    s = SimScheduler()
+    wal = WriteAheadLog.create(str(tmp_path / "wal"),
+                               group_commit_window=0.01, scheduler=s)
+    durable = []
+    wal.append(b"x", on_durable=lambda: durable.append("x"))
+    real_fsync = os.fsync
+    calls = {"n": 0}
+
+    def flaky(fd):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(28, "No space left on device")
+        return real_fsync(fd)
+
+    with mock.patch("os.fsync", side_effect=flaky):
+        s.advance(0.01)
+        assert durable == []  # failed fsync must not report durability
+        s.advance(0.02)  # retry window
+    assert durable == ["x"]
+    wal.close()
+
+
+def test_group_commit_cluster_defers_broadcasts_until_durable(tmp_path):
+    # End to end: replicas on REAL group-commit WALs still order correctly —
+    # the protocol's sends ride on_durable, so nothing is ever said that is
+    # not remembered (persist-before-broadcast under batched fsyncs).
+    from consensus_tpu.consensus import Consensus
+    from consensus_tpu.testing import Cluster
+    from consensus_tpu.testing.app import make_request
+
+    cluster = Cluster(4)
+    # Swap every node's WAL for a real group-commit log on disk.
+    for node_id, node in cluster.nodes.items():
+        wal_dir = str(tmp_path / f"wal-{node_id}")
+
+        def start_with_real_wal(node=node, wal_dir=wal_dir):
+            comm = cluster.network.register(node.node_id, node._on_message)
+            wal = WriteAheadLog.create(
+                wal_dir, group_commit_window=0.002, scheduler=cluster.scheduler
+            )
+            node.consensus = Consensus(
+                config=node.config,
+                scheduler=cluster.scheduler,
+                comm=comm,
+                application=node.app,
+                assembler=node.app,
+                wal=wal,
+                signer=node.app,
+                verifier=node.app,
+                request_inspector=node.app.inspector,
+                synchronizer=node.app,
+            )
+            node.consensus.start()
+            node.running = True
+
+        node.start = start_with_real_wal
+    cluster.start()
+    for i in range(3):
+        cluster.submit_to_all(make_request("gc", i))
+        assert cluster.run_until_ledger(i + 1, max_time=300.0), f"block {i} stalled"
+    cluster.assert_ledgers_consistent()
