@@ -505,6 +505,10 @@ class ViewChanger:
             "%d: %d nodes vote for views beyond %d — jumping to view change %d",
             self.self_id, len(senders_ahead), self.next_view, target,
         )
+        # A live embedded in-flight view belongs to the abandoned change; a
+        # late decide from it must not install the jumped-to view without a
+        # NewView quorum (the timeout escalation path does the same).
+        self._abandon_in_flight_view()
         self.curr_view = target - 1
         self.next_view = self.curr_view  # start_view_change bumps to target
         self._update_view_gauges()

@@ -1,8 +1,11 @@
 """Batched signature-verification models built on :mod:`consensus_tpu.ops`."""
 
+from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
 from consensus_tpu.models.ed25519 import Ed25519BatchVerifier, L
 from consensus_tpu.models.engine import BatchCoalescer
 from consensus_tpu.models.verifier import (
+    EcdsaP256Signer,
+    EcdsaP256VerifierMixin,
     Ed25519Signer,
     Ed25519VerifierMixin,
     commit_message,
@@ -10,6 +13,9 @@ from consensus_tpu.models.verifier import (
 )
 
 __all__ = [
+    "EcdsaP256BatchVerifier",
+    "EcdsaP256Signer",
+    "EcdsaP256VerifierMixin",
     "Ed25519BatchVerifier",
     "L",
     "BatchCoalescer",

@@ -1,0 +1,221 @@
+"""Batched ECDSA-P256 signature verification — the second crypto model
+family (BASELINE.json config 1 pairs naive_chain with ECDSA-P256).
+
+Same architecture as :mod:`consensus_tpu.models.ed25519`: the host parses,
+range-checks, hashes (SHA-256) and computes the scalar pair u1 = e/s,
+u2 = r/s (mod n, Python big-int — modular inversion of the *scalar* field
+is irregular host work); the device runs the regular 99%: an on-curve check
+for the public key and the fused double-scalar multiplication
+R' = u1*G + u2*Q as a 64-step 4-bit-window scan over complete P-256
+formulas, then the projective acceptance test X == r * Z (with the r + n
+second candidate when it exists).
+
+Native formats: signature = 64 bytes big-endian r || s; public key =
+65 bytes SEC1 uncompressed (0x04 || X || Y).  DER/cryptography interop
+helpers are provided for tests and embedders.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from consensus_tpu.models.ed25519 import _next_pow2
+from consensus_tpu.ops import field_p256 as fp
+from consensus_tpu.ops import p256
+
+N = p256.N
+
+_WINDOW_BITS = 4
+_WINDOWS = 256 // _WINDOW_BITS
+_TABLE = 1 << _WINDOW_BITS
+
+
+def _be_bytes_to_limb_rows(rows_be: np.ndarray) -> np.ndarray:
+    """(n, 32) big-endian byte rows -> (n, 32) little-endian limb rows."""
+    return rows_be[:, ::-1].astype(np.float32)
+
+
+def _scalars_to_window_digits(values: list[int]) -> np.ndarray:
+    """Scalars -> (64, n) 4-bit digits, MSB window first."""
+    n = len(values)
+    rows = np.zeros((n, 32), dtype=np.uint8)
+    for i, v in enumerate(values):
+        rows[i] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+    bits = np.unpackbits(rows, axis=-1, bitorder="little")  # (n, 256) LSB first
+    weights = np.array([1, 2, 4, 8], dtype=np.int32)
+    digits = bits.reshape(n, _WINDOWS, _WINDOW_BITS) @ weights
+    return np.ascontiguousarray(digits[:, ::-1].T)
+
+
+def verify_impl(
+    qx: jnp.ndarray,        # (32, batch) public key X limbs
+    qy: jnp.ndarray,        # (32, batch) public key Y limbs
+    u1_digits: jnp.ndarray, # (64, batch) windows of u1 = e/s mod n, MSB first
+    u2_digits: jnp.ndarray, # (64, batch) windows of u2 = r/s mod n
+    r1: jnp.ndarray,        # (32, batch) r as field limbs
+    r2: jnp.ndarray,        # (32, batch) r + n as field limbs (when valid)
+    has_r2: jnp.ndarray,    # (batch,) whether r + n < p
+    host_ok: jnp.ndarray,   # (batch,) host-side pre-checks passed
+) -> jnp.ndarray:
+    """Un-jitted kernel body; shards over the trailing batch axis."""
+    q = p256.affine_like(qx, qy)
+    q_ok = p256.on_curve(qx, qy)
+    g_table = p256.base_table_like(qx, _TABLE)
+    q_table = p256.multiples_table(q, _TABLE)
+    lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]
+
+    def step(acc: p256.Point, window):
+        d1, d2 = window
+        oh1 = (d1[None] == lanes).astype(jnp.float32)
+        oh2 = (d2[None] == lanes).astype(jnp.float32)
+        acc = p256.double(acc)
+        acc = p256.double(acc)
+        acc = p256.double(acc)
+        acc = p256.double(acc)
+        acc = p256.add(acc, p256.table_lookup(g_table, oh1))
+        acc = p256.add(acc, p256.table_lookup(q_table, oh2))
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, p256.identity_like(qx), (u1_digits, u2_digits))
+
+    # Accept iff R' is not the identity and x(R') ≡ r (mod n):
+    # X == r * Z or (r + n < p and X == (r + n) * Z), projectively.
+    nonzero = ~fp.is_zero(acc.z)
+    match1 = fp.eq(acc.x, fp.mul(r1, acc.z))
+    match2 = has_r2 & fp.eq(acc.x, fp.mul(r2, acc.z))
+    return host_ok & q_ok & nonzero & (match1 | match2)
+
+
+_verify_kernel = jax.jit(verify_impl)
+
+
+class EcdsaP256BatchVerifier:
+    """Verify many (message, signature, public key) triples at once."""
+
+    def __init__(self, *, pad_pow2: bool = True, min_device_batch: int = 1) -> None:
+        self._pad_pow2 = pad_pow2
+        self._min_device_batch = min_device_batch
+
+    def _prepare(self, messages, signatures, public_keys):
+        n = len(messages)
+        host_ok = np.ones(n, dtype=bool)
+        qx_rows = np.zeros((n, 32), dtype=np.uint8)
+        qy_rows = np.zeros((n, 32), dtype=np.uint8)
+        u1s = [0] * n
+        u2s = [0] * n
+        r1_rows = np.zeros((n, 32), dtype=np.uint8)
+        r2_rows = np.zeros((n, 32), dtype=np.uint8)
+        has_r2 = np.zeros(n, dtype=bool)
+        for i in range(n):
+            sig = signatures[i]
+            key = public_keys[i]
+            if len(sig) != 64 or len(key) != 65 or key[0] != 0x04:
+                host_ok[i] = False
+                continue
+            r = int.from_bytes(sig[:32], "big")
+            s = int.from_bytes(sig[32:], "big")
+            if not (1 <= r < N and 1 <= s < N):
+                host_ok[i] = False
+                continue
+            qx = int.from_bytes(key[1:33], "big")
+            qy = int.from_bytes(key[33:], "big")
+            if qx >= fp.P or qy >= fp.P:
+                host_ok[i] = False
+                continue
+            e = int.from_bytes(hashlib.sha256(messages[i]).digest(), "big")
+            w = pow(s, N - 2, N)
+            u1s[i] = (e * w) % N
+            u2s[i] = (r * w) % N
+            qx_rows[i] = np.frombuffer(key[1:33], dtype=np.uint8)
+            qy_rows[i] = np.frombuffer(key[33:], dtype=np.uint8)
+            r1_rows[i] = np.frombuffer(r.to_bytes(32, "big"), dtype=np.uint8)
+            if r + N < fp.P:
+                has_r2[i] = True
+                r2_rows[i] = np.frombuffer((r + N).to_bytes(32, "big"), dtype=np.uint8)
+        return (
+            _be_bytes_to_limb_rows(qx_rows),
+            _be_bytes_to_limb_rows(qy_rows),
+            _scalars_to_window_digits(u1s),
+            _scalars_to_window_digits(u2s),
+            _be_bytes_to_limb_rows(r1_rows),
+            _be_bytes_to_limb_rows(r2_rows),
+            has_r2,
+            host_ok,
+        )
+
+    def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
+        n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            raise ValueError("batch length mismatch")
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if n < self._min_device_batch:
+            return self._verify_host(messages, signatures, public_keys)
+        qx, qy, u1d, u2d, r1, r2, has_r2, host_ok = self._prepare(
+            messages, signatures, public_keys
+        )
+        padded = _next_pow2(n) if self._pad_pow2 else n
+        if padded != n:
+            pad = padded - n
+            qx = np.pad(qx, ((0, pad), (0, 0)))
+            qy = np.pad(qy, ((0, pad), (0, 0)))
+            u1d = np.pad(u1d, ((0, 0), (0, pad)))
+            u2d = np.pad(u2d, ((0, 0), (0, pad)))
+            r1 = np.pad(r1, ((0, pad), (0, 0)))
+            r2 = np.pad(r2, ((0, pad), (0, 0)))
+            has_r2 = np.pad(has_r2, (0, pad))
+            host_ok = np.pad(host_ok, (0, pad))
+        result = _verify_kernel(
+            jnp.asarray(np.ascontiguousarray(qx.T)),
+            jnp.asarray(np.ascontiguousarray(qy.T)),
+            jnp.asarray(u1d),
+            jnp.asarray(u2d),
+            jnp.asarray(np.ascontiguousarray(r1.T)),
+            jnp.asarray(np.ascontiguousarray(r2.T)),
+            jnp.asarray(has_r2),
+            jnp.asarray(host_ok),
+        )
+        return np.asarray(result)[:n]
+
+    @staticmethod
+    def _verify_host(messages, signatures, public_keys) -> np.ndarray:
+        """Sequential fallback via the ``cryptography`` package."""
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            encode_dss_signature,
+        )
+
+        out = np.zeros(len(messages), dtype=bool)
+        for i, (msg, sig, key) in enumerate(zip(messages, signatures, public_keys)):
+            try:
+                pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                    ec.SECP256R1(), bytes(key)
+                )
+                der = encode_dss_signature(
+                    int.from_bytes(sig[:32], "big"), int.from_bytes(sig[32:], "big")
+                )
+                pub.verify(der, bytes(msg), ec.ECDSA(hashes.SHA256()))
+                out[i] = True
+            except (InvalidSignature, ValueError):
+                out[i] = False
+        return out
+
+
+def raw_signature_from_der(der: bytes) -> bytes:
+    """DER ECDSA signature -> 64-byte big-endian r || s."""
+    from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+
+    r, s = decode_dss_signature(der)
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+__all__ = ["EcdsaP256BatchVerifier", "raw_signature_from_der", "N"]

@@ -120,7 +120,7 @@ class Ed25519VerifierMixin(Verifier):
             known.append(key is not None)
             messages.append(commit_message(proposal, sig.msg))
             sigs.append(sig.value)
-            keys.append(key if key is not None else b"\x00" * 32)
+            keys.append(key if key is not None else b"")
         ok = self._engine.verify_batch(messages, sigs, keys)
         return [
             signatures[i].msg if (known[i] and ok[i]) else None
@@ -131,9 +131,53 @@ class Ed25519VerifierMixin(Verifier):
         return msg
 
 
+class EcdsaP256Signer(Signer):
+    """ECDSA-P256 replica identity (private key host-side); signatures are
+    the framework's raw 64-byte r||s format."""
+
+    def __init__(self, node_id: int, private_key=None) -> None:
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        self.node_id = node_id
+        self._key = private_key or ec.generate_private_key(ec.SECP256R1())
+        self._hash = ec.ECDSA(hashes.SHA256())
+        self.public_bytes = self._key.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+        )
+
+    def _sign_raw(self, data: bytes) -> bytes:
+        from consensus_tpu.models.ecdsa_p256 import raw_signature_from_der
+
+        return raw_signature_from_der(self._key.sign(data, self._hash))
+
+    def sign(self, data: bytes) -> bytes:
+        return self._sign_raw(raw_message(data))
+
+    def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature:
+        return Signature(
+            id=self.node_id,
+            value=self._sign_raw(commit_message(proposal, aux)),
+            msg=aux,
+        )
+
+
+class EcdsaP256VerifierMixin(Ed25519VerifierMixin):
+    """Signature-verification half of the Verifier port over ECDSA-P256 —
+    same registry/batching semantics as the Ed25519 mixin, different curve
+    engine."""
+
+    def __init__(self, public_keys: Mapping[int, bytes], *, engine=None) -> None:
+        from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
+
+        super().__init__(public_keys, engine=engine or EcdsaP256BatchVerifier())
+
+
 __all__ = [
     "Ed25519Signer",
     "Ed25519VerifierMixin",
+    "EcdsaP256Signer",
+    "EcdsaP256VerifierMixin",
     "commit_message",
     "raw_message",
 ]

@@ -1,0 +1,265 @@
+"""GF(p256) arithmetic as batched JAX float32 limb vectors, where
+p256 = 2^256 - 2^224 + 2^192 + 2^96 - 1 (the NIST P-256 prime).
+
+Same discipline as :mod:`consensus_tpu.ops.field25519` — 32 x 8-bit limbs
+in float32, limbs leading / batch trailing, every product and column sum
+exact inside the 24-bit integer window — but the reduction differs: p256 is
+a Solinas prime, so 2^256 ≡ 2^224 - 2^192 - 2^96 + 1 (mod p), a *signed
+4-term byte pattern* rather than curve25519's small constant.  Folding the
+high half of a product is therefore four shifted adds/subs of the high
+limbs, iterated until the spill-over above limb 31 vanishes.
+
+Normalization contract: public ops take and return *weakly reduced*
+elements — |limb| <= 600, value exact mod p and |value| < 2^262 —
+multiplication-safe (600^2 * 32 < 2^24).  ``freeze`` produces the canonical
+int32 representative in [0, p).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+LIMBS = 32
+LIMB_BITS = 8
+BASE = 256.0
+INV_BASE = 1.0 / 256.0
+
+P = 2**256 - 2**224 + 2**192 + 2**96 - 1
+
+#: 2^256 mod p as a signed byte pattern: +1 at byte 0, -1 at byte 12,
+#: -1 at byte 24, +1 at byte 28.
+_FOLD_PATTERN: tuple[tuple[int, int], ...] = ((0, 1), (12, -1), (24, -1), (28, 1))
+assert sum(s * (1 << (8 * pos)) for pos, s in _FOLD_PATTERN) == (2**256) % P
+
+
+def int_to_limbs(value: int) -> np.ndarray:
+    if not 0 <= value < 2**256:
+        raise ValueError("value out of limb range")
+    return np.array(
+        [(value >> (LIMB_BITS * i)) & 0xFF for i in range(LIMBS)], dtype=np.float32
+    )
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(LIMBS))
+
+
+def _cexpand(const_limbs, like: jnp.ndarray) -> jnp.ndarray:
+    return jnp.reshape(jnp.asarray(const_limbs), (LIMBS,) + (1,) * (like.ndim - 1))
+
+
+def constant_like(value: int, like: jnp.ndarray) -> jnp.ndarray:
+    return like * 0 + _cexpand(int_to_limbs(value % P), like)
+
+
+def zeros_like(like: jnp.ndarray) -> jnp.ndarray:
+    return like * 0
+
+
+def _split(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    hi = jnp.floor(x * INV_BASE)
+    return x - hi * BASE, hi
+
+
+def _reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a wide (<= 63 limb) signed vector to 32 weakly reduced limbs
+    via the FIPS 186-4 fast-reduction word assembly for P-256.
+
+    The Solinas identity is linear in the 32-bit words of the 512-bit
+    value, so the nine s-terms can be assembled directly from *signed*
+    limb groups — no normalization needed beyond one carry-save pass to
+    keep every sum inside f32's exact-integer window."""
+    batch_pad = [(0, 0)] * (x.ndim - 1)
+    if x.shape[0] > 2 * LIMBS - 1:
+        raise ValueError(f"input too wide: {x.shape[0]}")
+    if x.shape[0] < 2 * LIMBS - 1:
+        x = jnp.pad(x, [(0, 2 * LIMBS - 1 - x.shape[0])] + batch_pad)
+    # One carry-save pass: |limb| drops to < 255 + 2^16 (width 64 exactly).
+    lo, hi = _split(x)
+    x = jnp.pad(lo, [(0, 1)] + batch_pad) + jnp.pad(hi, [(1, 0)] + batch_pad)
+
+    def word(i: int) -> jnp.ndarray:
+        return x[4 * i : 4 * i + 4]
+
+    zero4 = x[:4] * 0
+
+    def assemble(words) -> jnp.ndarray:
+        """words listed little-endian (w0..w7), each a 4-limb group."""
+        return jnp.concatenate(words, axis=0)
+
+    s1 = x[:LIMBS]
+    s2 = assemble([zero4, zero4, zero4, word(11), word(12), word(13), word(14), word(15)])
+    s3 = assemble([zero4, zero4, zero4, word(12), word(13), word(14), word(15), zero4])
+    s4 = assemble([word(8), word(9), word(10), zero4, zero4, zero4, word(14), word(15)])
+    s5 = assemble([word(9), word(10), word(11), word(13), word(14), word(15), word(13), word(8)])
+    s6 = assemble([word(11), word(12), word(13), zero4, zero4, zero4, word(8), word(10)])
+    s7 = assemble([word(12), word(13), word(14), word(15), zero4, zero4, word(9), word(11)])
+    s8 = assemble([word(13), word(14), word(15), word(8), word(9), word(10), zero4, word(12)])
+    s9 = assemble([word(14), word(15), zero4, word(9), word(10), word(11), zero4, word(13)])
+    r = s1 + 2.0 * s2 + 2.0 * s3 + s4 + s5 - s6 - s7 - s8 - s9  # |limb| < 2^20
+
+    # Two light rounds: carry-save + fold the single overflow limb through
+    # the 2^256 pattern.  Lands |limb| <= ~300.
+    for _ in range(2):
+        lo, hi = _split(r)
+        carried = jnp.pad(lo, [(0, 1)] + batch_pad) + jnp.pad(hi, [(1, 0)] + batch_pad)
+        r = carried[:LIMBS]
+        top = carried[LIMBS]
+        for pos, sign in _FOLD_PATTERN:
+            r = r.at[pos].add(sign * top)
+    return r
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _reduce_wide(a + b)
+
+
+#: 4p fits in 258 bits -> 33 limbs; keep a 32-limb bias of 8p? Use 2^8 * p
+#: trick instead: bias with (2^262-ish multiple) ... simpler: 4p as 33 limbs
+#: folded once at construction to a 32-limb *signed* equivalent: 4p mod
+#: 2^256 + fold of the top bits.  We just precompute 4p - k*p == value
+#: congruent 0 mod p that covers the subtrahend range; easiest correct
+#: choice: 8p reduced to a signed 32-limb vector via _reduce on ints.
+def _bias_limbs() -> np.ndarray:
+    # A multiple of p, >= 2^262 in value, expressed in 32 signed limbs with
+    # |limb| <= 300: take m = 128*p and greedily balance digits to +-128.
+    m = 128 * P
+    digits = []
+    carry = 0
+    v = m
+    for _ in range(LIMBS):
+        d = (v & 0xFF) + carry
+        v >>= 8
+        carry = 0
+        if d > 128:
+            d -= 256
+            carry = 1
+        digits.append(d)
+    # Remaining v (from bit 256 up, incl. final carry) folds via the
+    # Solinas pattern; it is tiny (< 2^7).
+    top = v + carry
+    for pos, sign in _FOLD_PATTERN:
+        digits[pos] += sign * top
+    arr = np.array(digits, dtype=np.float32)
+    assert limbs_to_int_signed(arr) % P == 0
+    return arr
+
+
+def limbs_to_int_signed(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[i]) << (LIMB_BITS * i) for i in range(LIMBS))
+
+
+_BIAS = None  # initialized lazily below (needs limbs_to_int_signed defined)
+
+
+def _get_bias() -> np.ndarray:
+    global _BIAS
+    if _BIAS is None:
+        _BIAS = _bias_limbs()
+    return _BIAS
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # Bias with a multiple of p large enough to keep the value positive for
+    # any weakly reduced operands.
+    return _reduce_wide(a + _cexpand(_get_bias(), a) - b)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook convolution (32 broadcast multiplies + shifted adds) then
+    the Solinas fold.  Weakly reduced inputs keep columns exact in f32."""
+    batch_pad = [(0, 0)] * (a.ndim - 1)
+    terms = [
+        jnp.pad(a[i] * b, [(i, LIMBS - 1 - i)] + batch_pad) for i in range(LIMBS)
+    ]
+    return _reduce_wide(sum(terms))
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    batch_pad = [(0, 0)] * (a.ndim - 1)
+    doubled = a + a
+    terms = []
+    for i in range(LIMBS):
+        row = jnp.concatenate([a[i : i + 1] * a[i], doubled[i + 1 :] * a[i]], axis=0)
+        terms.append(jnp.pad(row, [(2 * i, LIMBS - 1 - i)] + batch_pad))
+    return _reduce_wide(sum(terms))
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a * k for small positive k (<= 64)."""
+    return _reduce_wide(a * float(k))
+
+
+_P_LIMBS_I32 = np.array(
+    [(P >> (LIMB_BITS * i)) & 0xFF for i in range(LIMBS)], dtype=np.int32
+)
+
+
+def freeze(a: jnp.ndarray) -> jnp.ndarray:
+    """Canonical int32 representative in [0, p)."""
+    x = jnp.asarray(jnp.rint(a), dtype=jnp.int32)
+    x = x + jnp.reshape(jnp.asarray(_get_bias().astype(np.int32) * 0), x.shape[:1] + (1,) * (x.ndim - 1))  # no-op keep dtype
+    # Bias to positive using the signed multiple of p, then carry exactly.
+    x = x + jnp.reshape(jnp.asarray(_get_bias().astype(np.int32)), (LIMBS,) + (1,) * (a.ndim - 1))
+    # Sequential exact carry; value in (0, ~2^263): top carry folds via the
+    # Solinas pattern (iterate twice — the first fold's carry is tiny).
+    for _ in range(2):
+        out = []
+        carry = jnp.zeros_like(x[0])
+        for k in range(LIMBS):
+            v = x[k] + carry
+            out.append(v & 0xFF)
+            carry = v >> LIMB_BITS
+        x = jnp.stack(out, axis=0)
+        for pos, sign in _FOLD_PATTERN:
+            x = x.at[pos].add(sign * carry)
+    p_e = jnp.reshape(jnp.asarray(_P_LIMBS_I32), (LIMBS,) + (1,) * (a.ndim - 1))
+    for _ in range(3):
+        # Subtract p while the value still exceeds it (value < ~2^256 + eps
+        # after the carry folds; p ~ 2^256 (1 - 2^-32), so <= 3 rounds).
+        d = x - p_e
+        out = []
+        carry = jnp.zeros_like(x[0])
+        for k in range(LIMBS):
+            v = d[k] + carry
+            out.append(v & 0xFF)
+            carry = v >> LIMB_BITS
+        d = jnp.stack(out, axis=0)
+        ge_p = carry == 0
+        x = jnp.where(ge_p[None], d, x)
+    return x
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == freeze(b), axis=0)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(cond[None], a, b)
+
+
+__all__ = [
+    "LIMBS",
+    "P",
+    "int_to_limbs",
+    "limbs_to_int",
+    "constant_like",
+    "zeros_like",
+    "add",
+    "sub",
+    "mul",
+    "square",
+    "mul_small",
+    "freeze",
+    "eq",
+    "is_zero",
+    "select",
+]

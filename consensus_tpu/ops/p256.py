@@ -1,0 +1,238 @@
+"""NIST P-256 group operations on batched limb vectors.
+
+Short Weierstrass curve y^2 = x^3 - 3x + b over GF(p256), homogeneous
+projective coordinates (X : Y : Z), using the *complete* formulas of
+Renes–Costello–Batina 2015 (EUROCRYPT 2016), Algorithms 4 (addition,
+12M + 2mb) and 6 (doubling, 8M + 3S + 2mb) for a = -3: one branch-free
+code path valid for every input including the identity (0 : 1 : 0) and
+P + P — exactly what a fixed-shape batched scan needs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from consensus_tpu.ops import field_p256 as fp
+
+#: Curve constants (FIPS 186-4 / SEC2).
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+#: Group order.
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+
+
+class Point(NamedTuple):
+    """Batched projective point; each field is (32, *batch) float32."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+
+
+def identity_like(ref: jnp.ndarray) -> Point:
+    return Point(x=ref * 0, y=fp.constant_like(1, ref), z=ref * 0)
+
+
+def base_point_like(ref: jnp.ndarray) -> Point:
+    return Point(
+        x=fp.constant_like(GX, ref),
+        y=fp.constant_like(GY, ref),
+        z=fp.constant_like(1, ref),
+    )
+
+
+def affine_like(x_limbs: jnp.ndarray, y_limbs: jnp.ndarray) -> Point:
+    return Point(x=x_limbs, y=y_limbs, z=fp.constant_like(1, x_limbs))
+
+
+def add(p: Point, q: Point) -> Point:
+    """RCB15 Algorithm 4 (complete addition, a = -3)."""
+    b = fp.constant_like(B, p.x)
+    t0 = fp.mul(p.x, q.x)
+    t1 = fp.mul(p.y, q.y)
+    t2 = fp.mul(p.z, q.z)
+    t3 = fp.add(p.x, p.y)
+    t4 = fp.add(q.x, q.y)
+    t3 = fp.mul(t3, t4)
+    t4 = fp.add(t0, t1)
+    t3 = fp.sub(t3, t4)
+    t4 = fp.add(p.y, p.z)
+    t5 = fp.add(q.y, q.z)
+    t4 = fp.mul(t4, t5)
+    t5 = fp.add(t1, t2)
+    t4 = fp.sub(t4, t5)
+    x3 = fp.add(p.x, p.z)
+    y3 = fp.add(q.x, q.z)
+    x3 = fp.mul(x3, y3)
+    y3 = fp.add(t0, t2)
+    y3 = fp.sub(x3, y3)
+    z3 = fp.mul(b, t2)
+    x3 = fp.sub(y3, z3)
+    z3 = fp.add(x3, x3)
+    x3 = fp.add(x3, z3)
+    z3 = fp.sub(t1, x3)
+    x3 = fp.add(t1, x3)
+    y3 = fp.mul(b, y3)
+    t1 = fp.add(t2, t2)
+    t2 = fp.add(t1, t2)
+    y3 = fp.sub(y3, t2)
+    y3 = fp.sub(y3, t0)
+    t1 = fp.add(y3, y3)
+    y3 = fp.add(t1, y3)
+    t1 = fp.add(t0, t0)
+    t0 = fp.add(t1, t0)
+    t0 = fp.sub(t0, t2)
+    t1 = fp.mul(t4, y3)
+    t2 = fp.mul(t0, y3)
+    y3 = fp.mul(x3, z3)
+    y3 = fp.add(y3, t2)
+    x3 = fp.mul(t3, x3)
+    x3 = fp.sub(x3, t1)
+    z3 = fp.mul(t4, z3)
+    t1 = fp.mul(t3, t0)
+    z3 = fp.add(z3, t1)
+    return Point(x=x3, y=y3, z=z3)
+
+
+def double(p: Point) -> Point:
+    """RCB15 Algorithm 6 (exception-free doubling, a = -3)."""
+    b = fp.constant_like(B, p.x)
+    t0 = fp.square(p.x)
+    t1 = fp.square(p.y)
+    t2 = fp.square(p.z)
+    t3 = fp.mul(p.x, p.y)
+    t3 = fp.add(t3, t3)
+    z3 = fp.mul(p.x, p.z)
+    z3 = fp.add(z3, z3)
+    y3 = fp.mul(b, t2)
+    y3 = fp.sub(y3, z3)
+    x3 = fp.add(y3, y3)
+    y3 = fp.add(x3, y3)
+    x3 = fp.sub(t1, y3)
+    y3 = fp.add(t1, y3)
+    y3 = fp.mul(x3, y3)
+    x3 = fp.mul(x3, t3)
+    t3 = fp.add(t2, t2)
+    t2 = fp.add(t2, t3)
+    z3 = fp.mul(b, z3)
+    z3 = fp.sub(z3, t2)
+    z3 = fp.sub(z3, t0)
+    t3 = fp.add(z3, z3)
+    z3 = fp.add(z3, t3)
+    t3 = fp.add(t0, t0)
+    t0 = fp.add(t3, t0)
+    t0 = fp.sub(t0, t2)
+    t0 = fp.mul(t0, z3)
+    y3 = fp.add(y3, t0)
+    t0 = fp.mul(p.y, p.z)
+    t0 = fp.add(t0, t0)
+    z3 = fp.mul(t0, z3)
+    x3 = fp.sub(x3, z3)
+    z3 = fp.mul(t0, t1)
+    z3 = fp.add(z3, z3)
+    z3 = fp.add(z3, z3)
+    return Point(x=x3, y=y3, z=z3)
+
+
+def select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
+    return Point(
+        x=fp.select(cond, p.x, q.x),
+        y=fp.select(cond, p.y, q.y),
+        z=fp.select(cond, p.z, q.z),
+    )
+
+
+def table_lookup(table: Point, one_hot: jnp.ndarray) -> Point:
+    """table[digit] via a one-hot contraction (no gathers); coords are
+    (W, 32, *batch) or broadcastable."""
+    oh = one_hot[:, None]
+
+    def pick(coord: jnp.ndarray) -> jnp.ndarray:
+        return jnp.sum(coord * oh, axis=0)
+
+    return Point(x=pick(table.x), y=pick(table.y), z=pick(table.z))
+
+
+def multiples_table(p: Point, size: int = 16) -> Point:
+    entries = [identity_like(p.x), p]
+    for _ in range(size - 2):
+        entries.append(add(entries[-1], p))
+    return Point(
+        x=jnp.stack([e.x for e in entries]),
+        y=jnp.stack([e.y for e in entries]),
+        z=jnp.stack([e.z for e in entries]),
+    )
+
+
+def _affine_table_ints(size: int = 16) -> list[tuple[int, int]]:
+    """Host-side integer multiples of G (identity encoded as (0, 0))."""
+
+    def add_int(p1, p2):
+        if p1 is None:
+            return p2
+        if p2 is None:
+            return p1
+        x1, y1 = p1
+        x2, y2 = p2
+        if x1 == x2 and (y1 + y2) % fp.P == 0:
+            return None
+        if p1 == p2:
+            lam = (3 * x1 * x1 - 3) * pow(2 * y1, fp.P - 2, fp.P) % fp.P
+        else:
+            lam = (y2 - y1) * pow(x2 - x1, fp.P - 2, fp.P) % fp.P
+        x3 = (lam * lam - x1 - x2) % fp.P
+        return x3, (lam * (x1 - x3) - y1) % fp.P
+
+    table = [None]
+    for _ in range(size - 1):
+        table.append(add_int(table[-1], (GX, GY)))
+    return [(0, 0) if e is None else e for e in table]
+
+
+def base_table_like(ref: jnp.ndarray, size: int = 16) -> Point:
+    """Constant j*G table with proper projective identity at index 0."""
+    import numpy as np
+
+    ints = _affine_table_ints(size)
+    ones = (1,) * (ref.ndim - 1)
+
+    def coords(values):
+        arr = jnp.stack([jnp.asarray(fp.int_to_limbs(v)) for v in values])
+        return (ref[None, :] * 0) + arr.reshape(size, fp.LIMBS, *ones)
+
+    xs = coords([x for x, _ in ints])
+    ys = coords([y if (x, y) != (0, 0) else 1 for x, y in ints])
+    zs = coords([0 if (x, y) == (0, 0) else 1 for x, y in ints])
+    return Point(x=xs, y=ys, z=zs)
+
+
+def on_curve(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """y^2 == x^3 - 3x + b (affine check for parsed public keys)."""
+    lhs = fp.square(y)
+    x3 = fp.mul(fp.square(x), x)
+    rhs = fp.add(
+        fp.sub(x3, fp.mul_small(x, 3)), fp.constant_like(B, x)
+    )
+    return fp.eq(lhs, rhs)
+
+
+__all__ = [
+    "Point",
+    "B",
+    "GX",
+    "GY",
+    "N",
+    "identity_like",
+    "base_point_like",
+    "affine_like",
+    "add",
+    "double",
+    "select",
+    "table_lookup",
+    "multiples_table",
+    "base_table_like",
+    "on_curve",
+]

@@ -181,6 +181,11 @@ class WriteAheadLog:
             self._sync_timer = None
         if self._sync_waiters or self._sync_pending:
             if not self.flush_group():
+                # flush_group re-armed a retry; a post-close retry firing
+                # protocol callbacks would be worse than failing loudly.
+                if self._sync_timer is not None:
+                    self._sync_timer.cancel()
+                    self._sync_timer = None
                 raise WALError(
                     "close: pending records could not be made durable"
                 )
@@ -218,11 +223,17 @@ class WriteAheadLog:
             if self._group_window:
                 # The restore point must be durable BEFORE the history it
                 # replaces is deleted, or a crash in the window loses both.
-                # On fsync failure the deletion rides the retry queue.
+                # On fsync failure the deletion rides the retry queue — with
+                # the segment index captured NOW: by retry time a rollover
+                # may have bumped it, and deleting against the new index
+                # would destroy the segment holding the restore point.
                 if self.flush_group():
                     self._drop_old_segments()
                 else:
-                    self._sync_waiters.append(self._drop_old_segments)
+                    keep = self._segment_index
+                    self._sync_waiters.append(
+                        lambda: self._drop_segments_below(keep)
+                    )
             else:
                 self._drop_old_segments()
         if self._file.tell() >= self._segment_max_bytes:
@@ -308,8 +319,11 @@ class WriteAheadLog:
             _fsync_dir(self._dir)
 
     def _drop_old_segments(self) -> None:
+        self._drop_segments_below(self._segment_index)
+
+    def _drop_segments_below(self, keep_index: int) -> None:
         for index, name in _list_segments(self._dir):
-            if index < self._segment_index:
+            if index < keep_index:
                 os.unlink(os.path.join(self._dir, name))
         if self._sync:
             _fsync_dir(self._dir)

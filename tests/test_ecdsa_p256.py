@@ -1,0 +1,223 @@
+"""ECDSA-P256 model family: field/point correctness (covered in ops tests
+below), batch verification against OpenSSL, the consensus port adapters,
+and a live cluster ordering blocks under real P-256 signatures.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from consensus_tpu.models import EcdsaP256BatchVerifier, EcdsaP256Signer, EcdsaP256VerifierMixin
+from consensus_tpu.models.ecdsa_p256 import N, raw_signature_from_der
+from consensus_tpu.ops import field_p256 as fp
+from consensus_tpu.ops import p256
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.types import Proposal, Signature
+
+
+def limbs_of(values):
+    return jnp.asarray(np.stack([fp.int_to_limbs(v) for v in values], axis=1))
+
+
+def ints_of(arr):
+    frozen = np.asarray(fp.freeze(arr))
+    return [fp.limbs_to_int(frozen[:, i]) for i in range(frozen.shape[1])]
+
+
+class TestFieldP256:
+    def test_ops_match_bigint(self):
+        rng = random.Random(7)
+        a_vals = [rng.randrange(fp.P) for _ in range(8)] + [0, 1, fp.P - 1]
+        b_vals = [rng.randrange(fp.P) for _ in range(8)] + [fp.P - 1, 2, fp.P - 1]
+        a, b = limbs_of(a_vals), limbs_of(b_vals)
+        assert ints_of(fp.mul(a, b)) == [(x * y) % fp.P for x, y in zip(a_vals, b_vals)]
+        assert ints_of(fp.add(a, b)) == [(x + y) % fp.P for x, y in zip(a_vals, b_vals)]
+        assert ints_of(fp.sub(a, b)) == [(x - y) % fp.P for x, y in zip(a_vals, b_vals)]
+        assert ints_of(fp.square(a)) == [x * x % fp.P for x in a_vals]
+
+    def test_deep_chain(self):
+        rng = random.Random(9)
+        vals = [rng.randrange(fp.P) for _ in range(4)]
+        other = [rng.randrange(fp.P) for _ in range(4)]
+        x, y = limbs_of(vals), limbs_of(other)
+        w = list(vals)
+        for i in range(45):
+            if i % 3 == 0:
+                x = fp.mul(x, y); w = [(u * v) % fp.P for u, v in zip(w, other)]
+            elif i % 3 == 1:
+                x = fp.sub(x, y); w = [(u - v) % fp.P for u, v in zip(w, other)]
+            else:
+                x = fp.square(x); w = [u * u % fp.P for u in w]
+        assert ints_of(x) == w
+
+
+class TestPointsP256:
+    def _affine(self, pt, idx=0):
+        X = ints_of(pt.x)[idx]
+        Y = ints_of(pt.y)[idx]
+        Z = ints_of(pt.z)[idx]
+        if Z == 0:
+            return None
+        zi = pow(Z, fp.P - 2, fp.P)
+        return (X * zi) % fp.P, (Y * zi) % fp.P
+
+    def test_double_add_identity_inverse(self):
+        ref = jnp.zeros((32, 1), dtype=jnp.float32)
+        g = p256.base_point_like(ref)
+        table = p256._affine_table_ints(5)
+        assert self._affine(p256.double(g)) == table[2]
+        assert self._affine(p256.add(g, g)) == table[2]
+        assert self._affine(p256.add(p256.double(g), g)) == table[3]
+        ident = p256.identity_like(ref)
+        assert self._affine(p256.add(g, ident)) == table[1]
+        neg = p256.Point(x=g.x, y=fp.sub(g.y * 0, g.y), z=g.z)
+        assert self._affine(p256.add(g, neg)) is None
+
+    def test_on_curve(self):
+        ref = jnp.zeros((32, 1), dtype=jnp.float32)
+        g = p256.base_point_like(ref)
+        assert bool(p256.on_curve(g.x, g.y)[0])
+        assert not bool(p256.on_curve(fp.constant_like(5, ref), g.y)[0])
+
+
+def make_sigs(n):
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    msgs, sigs, keys = [], [], []
+    for i in range(n):
+        sk = ec.generate_private_key(ec.SECP256R1())
+        pk = sk.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+        )
+        m = b"p256-%d" % i
+        msgs.append(m)
+        sigs.append(raw_signature_from_der(sk.sign(m, ec.ECDSA(hashes.SHA256()))))
+        keys.append(pk)
+    return msgs, sigs, keys
+
+
+class TestBatchVerifier:
+    def test_valid_and_corruption_modes(self):
+        msgs, sigs, keys = make_sigs(8)
+        v = EcdsaP256BatchVerifier()
+        assert v.verify_batch(msgs, sigs, keys).all()
+
+        bad = list(sigs)
+        bad[0] = bytes([sigs[0][0] ^ 1]) + sigs[0][1:]       # flipped r
+        bad[1] = sigs[1][:32] + bytes(32)                    # s = 0
+        bad[2] = sigs[2][:32] + N.to_bytes(32, "big")        # s = n
+        bad[3] = b"short"
+        ok = v.verify_batch(msgs, bad, keys)
+        assert not ok[:4].any() and ok[4:].all()
+
+        wrong_msg = [b"x" + m for m in msgs]
+        assert not v.verify_batch(wrong_msg, sigs, keys).any()
+        swapped = keys[1:] + keys[:1]
+        assert not v.verify_batch(msgs, sigs, swapped).any()
+
+    def test_bad_key_encodings_rejected(self):
+        msgs, sigs, keys = make_sigs(2)
+        bad_keys = list(keys)
+        bad_keys[0] = b"\x02" + keys[0][1:33]            # compressed form
+        bad_keys[1] = b"\x04" + bytes(64)                # not on curve
+        ok = EcdsaP256BatchVerifier().verify_batch(msgs, sigs, bad_keys)
+        assert not ok.any()
+
+    def test_device_matches_host_fallback(self):
+        msgs, sigs, keys = make_sigs(4)
+        bad = list(sigs)
+        bad[2] = bytes(64)
+        device = EcdsaP256BatchVerifier(min_device_batch=1).verify_batch(msgs, bad, keys)
+        host = EcdsaP256BatchVerifier(min_device_batch=10**9).verify_batch(msgs, bad, keys)
+        assert (device == host).all()
+
+
+class _SigOnly(EcdsaP256VerifierMixin):
+    def verify_proposal(self, proposal):
+        return []
+
+    def verify_request(self, raw):
+        raise NotImplementedError
+
+    def verification_sequence(self):
+        return 0
+
+    def requests_from_proposal(self, proposal):
+        return []
+
+
+class TestPortAdapters:
+    def test_sign_and_batch_verify_quorum(self):
+        signers = {i: EcdsaP256Signer(i) for i in (1, 2, 3)}
+        verifier = _SigOnly({i: s.public_bytes for i, s in signers.items()})
+        proposal = Proposal(payload=b"batch")
+        sigs = [signers[i].sign_proposal(proposal, b"aux-%d" % i) for i in (1, 2, 3)]
+        assert verifier.verify_consenter_sigs_batch(sigs, proposal) == [
+            b"aux-1", b"aux-2", b"aux-3"
+        ]
+        tampered = Signature(id=1, value=sigs[0].value, msg=b"other-aux")
+        assert verifier.verify_consenter_sigs_batch([tampered], proposal) == [None]
+
+    def test_raw_signature_path(self):
+        signer = EcdsaP256Signer(5)
+        verifier = _SigOnly({5: signer.public_bytes})
+        data = b"view-data"
+        verifier.verify_signature(Signature(id=5, value=signer.sign(data), msg=data))
+        with pytest.raises(ValueError):
+            verifier.verify_signature(Signature(id=5, value=bytes(64), msg=data))
+
+
+def test_cluster_orders_with_real_p256_signatures():
+    # The protocol running entirely on ECDSA-P256: decisions carry verifying
+    # quorums under the registered keys.
+    from consensus_tpu.models.verifier import commit_message
+    from consensus_tpu.testing import TestApp
+
+    class CryptoApp(TestApp):
+        def __init__(self, node_id, cluster, signer, verifier):
+            super().__init__(node_id, cluster)
+            self._signer = signer
+            self._verifier = verifier
+
+        def sign(self, data):
+            return self._signer.sign(data)
+
+        def sign_proposal(self, proposal, aux=b""):
+            return self._signer.sign_proposal(proposal, aux)
+
+        def verify_consenter_sig(self, signature, proposal):
+            return self._verifier.verify_consenter_sig(signature, proposal)
+
+        def verify_consenter_sigs_batch(self, signatures, proposal):
+            return self._verifier.verify_consenter_sigs_batch(signatures, proposal)
+
+        def verify_signature(self, signature):
+            return self._verifier.verify_signature(signature)
+
+        def auxiliary_data(self, msg):
+            return self._verifier.auxiliary_data(msg)
+
+    cluster = Cluster(4)
+    signers = {i: EcdsaP256Signer(i) for i in cluster.nodes}
+    keys = {i: s.public_bytes for i, s in signers.items()}
+    for node_id, node in cluster.nodes.items():
+        node.app = CryptoApp(node_id, cluster, signers[node_id], _SigOnly(keys))
+    cluster.start()
+    for i in range(2):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, max_time=300.0), f"block {i} stalled"
+    cluster.assert_ledgers_consistent()
+    for node in cluster.nodes.values():
+        for decision in node.app.ledger:
+            assert len(decision.signatures) >= 3
+            msgs = [commit_message(decision.proposal, s.msg) for s in decision.signatures]
+            ok = EcdsaP256BatchVerifier(min_device_batch=10**9).verify_batch(
+                msgs,
+                [s.value for s in decision.signatures],
+                [keys[s.id] for s in decision.signatures],
+            )
+            assert ok.all(), "ledger carries an invalid P-256 signature"
