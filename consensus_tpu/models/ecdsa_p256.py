@@ -96,6 +96,36 @@ def verify_impl(
 _verify_kernel = jax.jit(verify_impl)
 
 
+def pad_prepared(prepped, padded: int):
+    """Pad the 8 host-side arrays to ``padded`` batch elements."""
+    qx, qy, u1d, u2d, r1, r2, has_r2, host_ok = prepped
+    pad = padded - len(host_ok)
+    if pad:
+        qx = np.pad(qx, ((0, pad), (0, 0)))
+        qy = np.pad(qy, ((0, pad), (0, 0)))
+        u1d = np.pad(u1d, ((0, 0), (0, pad)))
+        u2d = np.pad(u2d, ((0, 0), (0, pad)))
+        r1 = np.pad(r1, ((0, pad), (0, 0)))
+        r2 = np.pad(r2, ((0, pad), (0, 0)))
+        has_r2 = np.pad(has_r2, (0, pad))
+        host_ok = np.pad(host_ok, (0, pad))
+    return qx, qy, u1d, u2d, r1, r2, has_r2, host_ok
+
+
+def to_kernel_layout(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok):
+    """Host row-major arrays -> device layout (vector axis leading)."""
+    return (
+        jnp.asarray(np.ascontiguousarray(qx.T)),
+        jnp.asarray(np.ascontiguousarray(qy.T)),
+        jnp.asarray(u1d),
+        jnp.asarray(u2d),
+        jnp.asarray(np.ascontiguousarray(r1.T)),
+        jnp.asarray(np.ascontiguousarray(r2.T)),
+        jnp.asarray(has_r2),
+        jnp.asarray(host_ok),
+    )
+
+
 class EcdsaP256BatchVerifier:
     """Verify many (message, signature, public key) triples at once."""
 
@@ -158,30 +188,9 @@ class EcdsaP256BatchVerifier:
             return np.zeros(0, dtype=bool)
         if n < self._min_device_batch:
             return self._verify_host(messages, signatures, public_keys)
-        qx, qy, u1d, u2d, r1, r2, has_r2, host_ok = self._prepare(
-            messages, signatures, public_keys
-        )
+        prepped = self._prepare(messages, signatures, public_keys)
         padded = _next_pow2(n) if self._pad_pow2 else n
-        if padded != n:
-            pad = padded - n
-            qx = np.pad(qx, ((0, pad), (0, 0)))
-            qy = np.pad(qy, ((0, pad), (0, 0)))
-            u1d = np.pad(u1d, ((0, 0), (0, pad)))
-            u2d = np.pad(u2d, ((0, 0), (0, pad)))
-            r1 = np.pad(r1, ((0, pad), (0, 0)))
-            r2 = np.pad(r2, ((0, pad), (0, 0)))
-            has_r2 = np.pad(has_r2, (0, pad))
-            host_ok = np.pad(host_ok, (0, pad))
-        result = _verify_kernel(
-            jnp.asarray(np.ascontiguousarray(qx.T)),
-            jnp.asarray(np.ascontiguousarray(qy.T)),
-            jnp.asarray(u1d),
-            jnp.asarray(u2d),
-            jnp.asarray(np.ascontiguousarray(r1.T)),
-            jnp.asarray(np.ascontiguousarray(r2.T)),
-            jnp.asarray(has_r2),
-            jnp.asarray(host_ok),
-        )
+        result = _verify_kernel(*to_kernel_layout(*pad_prepared(prepped, padded)))
         return np.asarray(result)[:n]
 
     @staticmethod
@@ -218,4 +227,10 @@ def raw_signature_from_der(der: bytes) -> bytes:
     return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
 
-__all__ = ["EcdsaP256BatchVerifier", "raw_signature_from_der", "N"]
+__all__ = [
+    "EcdsaP256BatchVerifier",
+    "raw_signature_from_der",
+    "pad_prepared",
+    "to_kernel_layout",
+    "N",
+]

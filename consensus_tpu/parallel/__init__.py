@@ -2,8 +2,10 @@
 
 from consensus_tpu.parallel.sharding import (
     BATCH_AXIS,
+    ShardedEcdsaP256Verifier,
     ShardedEd25519Verifier,
     make_mesh,
+    sharded_p256_verify_fn,
     sharded_verify_fn,
 )
 
@@ -11,5 +13,7 @@ __all__ = [
     "BATCH_AXIS",
     "make_mesh",
     "sharded_verify_fn",
+    "sharded_p256_verify_fn",
     "ShardedEd25519Verifier",
+    "ShardedEcdsaP256Verifier",
 ]

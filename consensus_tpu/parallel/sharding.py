@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
 from consensus_tpu.models.ed25519 import (
     Ed25519BatchVerifier,
     to_kernel_layout,
@@ -46,6 +47,17 @@ _IN_SPECS = (
     P(None, BATCH_AXIS),  # k_bits
     P(BATCH_AXIS),        # host_ok
 )
+
+
+def mesh_padded_size(n: int, n_shards: int, minimum: int = 8) -> int:
+    """Pow-2 growth for compile-shape reuse, then rounded UP to a multiple
+    of the mesh size — terminates for any shard count (a pure doubling loop
+    never exits for non-power-of-two meshes)."""
+    size = minimum
+    while size < n:
+        size *= 2
+    size += (-size) % n_shards
+    return size
 
 
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -81,22 +93,17 @@ class ShardedEd25519Verifier(Ed25519BatchVerifier):
         self._fn = sharded_verify_fn(self.mesh)
         self._n_shards = self.mesh.devices.size
 
-    def _pad_to(self, n: int) -> int:
-        # Pow-2 padding AND divisibility by the mesh size.
-        size = max(self._n_shards, 8)
-        while size < n or size % self._n_shards:
-            size *= 2
-        return size
-
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            raise ValueError("batch length mismatch")
         if n == 0:
             return np.zeros(0, dtype=bool)
         # Reuse the host-side preparation from the base class by padding to
         # the mesh-aligned size before the kernel call.
         prepped = self._prepare(messages, signatures, public_keys)
         y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok = prepped
-        padded = self._pad_to(n)
+        padded = mesh_padded_size(n, self._n_shards)
         if padded != n:
             pad = padded - n
             y_r = np.pad(y_r, ((0, pad), (0, 0)))
@@ -117,4 +124,75 @@ class ShardedEd25519Verifier(Ed25519BatchVerifier):
         return np.asarray(ok)[:n]
 
 
-__all__ = ["make_mesh", "sharded_verify_fn", "ShardedEd25519Verifier", "BATCH_AXIS"]
+# --- ECDSA-P256 sharding ---------------------------------------------------
+
+#: Device-layout specs for the P-256 kernel (see models/ecdsa_p256.py):
+#: limb/digit arrays lead with their vector axis, batch trails.
+_P256_IN_SPECS = (
+    P(None, BATCH_AXIS),  # qx
+    P(None, BATCH_AXIS),  # qy
+    P(None, BATCH_AXIS),  # u1 digits
+    P(None, BATCH_AXIS),  # u2 digits
+    P(None, BATCH_AXIS),  # r1
+    P(None, BATCH_AXIS),  # r2
+    P(BATCH_AXIS),        # has_r2
+    P(BATCH_AXIS),        # host_ok
+)
+
+
+def sharded_p256_verify_fn(mesh: Mesh):
+    """jitted ECDSA-P256 verify over ``mesh`` with a psum valid count."""
+    from consensus_tpu.models.ecdsa_p256 import verify_impl as p256_verify_impl
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=_P256_IN_SPECS,
+        out_specs=(P(BATCH_AXIS), P()),
+    )
+    def _shard(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok):
+        ok = p256_verify_impl(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok)
+        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
+        return ok, total
+
+    return jax.jit(_shard)
+
+
+class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):
+    """ECDSA-P256 batch verifier spread across a device mesh (reuses the
+    base class's preparation/validation; only the launch path differs)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, **kw) -> None:
+        super().__init__(**kw)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._fn = sharded_p256_verify_fn(self.mesh)
+        self._n_shards = self.mesh.devices.size
+
+    def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
+        from consensus_tpu.models.ecdsa_p256 import pad_prepared, to_kernel_layout
+
+        n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            raise ValueError("batch length mismatch")
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        prepped = self._prepare(messages, signatures, public_keys)
+        padded = mesh_padded_size(n, self._n_shards)
+        device_args = to_kernel_layout(*pad_prepared(prepped, padded))
+        args = [
+            jax.device_put(a, NamedSharding(self.mesh, spec))
+            for a, spec in zip(device_args, _P256_IN_SPECS)
+        ]
+        ok, _total = self._fn(*args)
+        return np.asarray(ok)[:n]
+
+
+__all__ = [
+    "make_mesh",
+    "sharded_verify_fn",
+    "sharded_p256_verify_fn",
+    "ShardedEd25519Verifier",
+    "ShardedEcdsaP256Verifier",
+    "mesh_padded_size",
+    "BATCH_AXIS",
+]

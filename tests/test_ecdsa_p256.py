@@ -221,3 +221,19 @@ def test_cluster_orders_with_real_p256_signatures():
                 [keys[s.id] for s in decision.signatures],
             )
             assert ok.all(), "ledger carries an invalid P-256 signature"
+
+
+def test_sharded_p256_matches_single_device():
+    import jax
+
+    from consensus_tpu.parallel import ShardedEcdsaP256Verifier, make_mesh
+
+    msgs, sigs, keys = make_sigs(12)
+    bad = list(sigs)
+    bad[5] = bytes(64)
+    mesh = make_mesh()
+    assert mesh.devices.size == 8
+    sharded = ShardedEcdsaP256Verifier(mesh).verify_batch(msgs, bad, keys)
+    single = EcdsaP256BatchVerifier().verify_batch(msgs, bad, keys)
+    assert (sharded == single).all()
+    assert sharded.sum() == 11 and not sharded[5]
