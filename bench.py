@@ -1,4 +1,7 @@
-"""Benchmark: TPU-batched Ed25519 verification vs the sequential host path.
+"""Benchmark: TPU-batched signature verification vs the sequential host path.
+
+``python bench.py`` benchmarks Ed25519 (the headline metric);
+``python bench.py p256`` benchmarks the ECDSA-P256 family instead.
 
 This is the framework's headline number (BASELINE.md north star): the
 reference verifies each commit signature sequentially on CPU inside its own
@@ -49,14 +52,31 @@ def make_signatures(n: int):
     return msgs, sigs, keys
 
 
-def bench_device(msgs, sigs, keys) -> float:
-    """Pipelined end-to-end throughput: host preparation of batch i+1
+def _pipelined_rate(prep_fn, kernel, batch_len: int) -> float:
+    """Shared pipelined timing harness: host preparation of batch i+1
     overlaps device execution of batch i (what a serving replica does), so
-    steady-state throughput is max(prep, device) rather than their sum."""
+    steady-state throughput is max(prep, device) rather than their sum.
+    The first prep is inside the timed region (no free pipeline fill)."""
     import concurrent.futures
 
     import numpy as np
 
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+        start = time.perf_counter()
+        pending = pool.submit(prep_fn)
+        results = []
+        for i in range(DEVICE_ITERS):
+            args = pending.result()
+            if i + 1 < DEVICE_ITERS:
+                pending = pool.submit(prep_fn)  # overlap next prep
+            results.append(kernel(*args))
+        total_valid = sum(int(np.asarray(r).sum()) for r in results)
+        elapsed = time.perf_counter() - start
+    assert total_valid == batch_len * DEVICE_ITERS
+    return batch_len * DEVICE_ITERS / elapsed
+
+
+def bench_device(msgs, sigs, keys) -> float:
     from consensus_tpu.models import Ed25519BatchVerifier
     from consensus_tpu.models.ed25519 import (
         _next_pow2,
@@ -76,21 +96,7 @@ def bench_device(msgs, sigs, keys) -> float:
     def prep():
         return to_kernel_layout(*verifier._prepare(msgs, sigs, keys))
 
-    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
-        # The first prep is inside the timed region: every counted batch
-        # pays its preparation in the window (no free pipeline fill).
-        start = time.perf_counter()
-        pending = pool.submit(prep)
-        results = []
-        for i in range(DEVICE_ITERS):
-            args = pending.result()
-            if i + 1 < DEVICE_ITERS:
-                pending = pool.submit(prep)  # overlap next prep with this launch
-            results.append(_verify_kernel(*args))
-        total_valid = sum(int(np.asarray(r).sum()) for r in results)
-        elapsed = time.perf_counter() - start
-    assert total_valid == len(msgs) * DEVICE_ITERS
-    return len(msgs) * DEVICE_ITERS / elapsed
+    return _pipelined_rate(prep, _verify_kernel, len(msgs))
 
 
 def bench_host(msgs, sigs, keys) -> float:
@@ -102,6 +108,67 @@ def bench_host(msgs, sigs, keys) -> float:
         Ed25519PublicKey.from_public_bytes(keys[i]).verify(sigs[i], msgs[i])
     elapsed = time.perf_counter() - start
     return n / elapsed
+
+
+def make_p256_signatures(n: int):
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    from consensus_tpu.models.ecdsa_p256 import raw_signature_from_der
+
+    signers = []
+    for _ in range(16):
+        sk = ec.generate_private_key(ec.SECP256R1())
+        pk = sk.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+        )
+        signers.append((sk, pk))
+    msgs, sigs, keys = [], [], []
+    for i in range(n):
+        sk, pk = signers[i % len(signers)]
+        m = b"request-%d" % i
+        msgs.append(m)
+        sigs.append(raw_signature_from_der(sk.sign(m, ec.ECDSA(hashes.SHA256()))))
+        keys.append(pk)
+    return msgs, sigs, keys
+
+
+def bench_p256(msgs, sigs, keys) -> tuple[float, float]:
+    """(device pipelined rate, sequential host rate) for ECDSA-P256."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import encode_dss_signature
+
+    from consensus_tpu.models.ecdsa_p256 import (
+        EcdsaP256BatchVerifier,
+        _next_pow2,
+        _verify_kernel,
+        pad_prepared,
+        to_kernel_layout,
+    )
+
+    assert len(msgs) == _next_pow2(len(msgs)), "BATCH must be a power of two >= 8"
+    verifier = EcdsaP256BatchVerifier()
+    ok = verifier.verify_batch(msgs, sigs, keys)
+    assert ok.all(), "benchmark signatures must verify"
+
+    def prep():
+        return to_kernel_layout(*pad_prepared(
+            verifier._prepare(msgs, sigs, keys), len(msgs)
+        ))
+
+    device_rate = _pipelined_rate(prep, _verify_kernel, len(msgs))
+
+    n = min(HOST_SAMPLE, len(msgs))
+    start = time.perf_counter()
+    for i in range(n):
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256R1(), keys[i])
+        der = encode_dss_signature(
+            int.from_bytes(sigs[i][:32], "big"), int.from_bytes(sigs[i][32:], "big")
+        )
+        pub.verify(der, msgs[i], ec.ECDSA(hashes.SHA256()))
+    host_rate = n / (time.perf_counter() - start)
+    return device_rate, host_rate
 
 
 def _probe_device(timeout: float = 90.0) -> bool:
@@ -125,11 +192,16 @@ def _probe_device(timeout: float = 90.0) -> bool:
 
 
 def main() -> None:
+    metric = (
+        "ecdsa_p256_verify_throughput"
+        if len(sys.argv) > 1 and sys.argv[1] == "p256"
+        else "ed25519_verify_throughput"
+    )
     if not _probe_device():
         print(
             json.dumps(
                 {
-                    "metric": "ed25519_verify_throughput",
+                    "metric": metric,
                     "value": 0,
                     "unit": "sigs/sec",
                     "vs_baseline": 0,
@@ -143,13 +215,17 @@ def main() -> None:
     import jax
 
     backend = jax.default_backend()
-    msgs, sigs, keys = make_signatures(BATCH)
-    device_rate = bench_device(msgs, sigs, keys)
-    host_rate = bench_host(msgs, sigs, keys)
+    if metric == "ecdsa_p256_verify_throughput":
+        msgs, sigs, keys = make_p256_signatures(BATCH)
+        device_rate, host_rate = bench_p256(msgs, sigs, keys)
+    else:
+        msgs, sigs, keys = make_signatures(BATCH)
+        device_rate = bench_device(msgs, sigs, keys)
+        host_rate = bench_host(msgs, sigs, keys)
     print(
         json.dumps(
             {
-                "metric": "ed25519_verify_throughput",
+                "metric": metric,
                 "value": round(device_rate, 1),
                 "unit": "sigs/sec",
                 "vs_baseline": round(device_rate / host_rate, 3),

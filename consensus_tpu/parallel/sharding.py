@@ -99,6 +99,8 @@ class ShardedEd25519Verifier(Ed25519BatchVerifier):
             raise ValueError("batch length mismatch")
         if n == 0:
             return np.zeros(0, dtype=bool)
+        if n < self._min_device_batch:
+            return self._verify_host(messages, signatures, public_keys)
         # Reuse the host-side preparation from the base class by padding to
         # the mesh-aligned size before the kernel call.
         prepped = self._prepare(messages, signatures, public_keys)
@@ -176,6 +178,8 @@ class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):
             raise ValueError("batch length mismatch")
         if n == 0:
             return np.zeros(0, dtype=bool)
+        if n < self._min_device_batch:
+            return self._verify_host(messages, signatures, public_keys)
         prepped = self._prepare(messages, signatures, public_keys)
         padded = mesh_padded_size(n, self._n_shards)
         device_args = to_kernel_layout(*pad_prepared(prepped, padded))
