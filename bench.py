@@ -192,6 +192,9 @@ def _probe_device(timeout: float = 90.0) -> bool:
 
 
 def main() -> None:
+    from __graft_entry__ import _enable_compile_cache
+
+    _enable_compile_cache()
     metric = (
         "ecdsa_p256_verify_throughput"
         if len(sys.argv) > 1 and sys.argv[1] == "p256"

@@ -81,6 +81,15 @@ class Consensus:
         self.last_signatures = tuple(last_signatures)
         self.membership_notifier = membership_notifier
         self.metrics = metrics or Metrics()
+        # The WAL is constructed by the embedder (it may pre-exist restart);
+        # attach the facade's WAL bundle here so wal_count_of_files is live
+        # without the embedder threading metrics twice.  Parity: reference
+        # pkg/wal NewMetrics wiring in consensus.go.
+        if (
+            hasattr(wal, "attach_metrics")
+            and getattr(wal, "_metrics", None) is None
+        ):
+            wal.attach_metrics(self.metrics.wal)
 
         self.nodes: tuple[int, ...] = ()
         self.controller: Optional[Controller] = None

@@ -191,7 +191,11 @@ class HeartbeatMonitor:
         if hb.view < self._view:
             self._comm.send(sender, HeartBeatResponse(view=self._view))
             return
-        if not self._suppress_leader_sends and sender != self._leader_id:
+        # Only the current leader's heartbeats reset the follower timeout —
+        # even while suppress_leader_sends has this (leader) node monitoring
+        # as a follower, or a Byzantine non-leader could keep feeding the
+        # timer and mute the complaint path.
+        if sender != self._leader_id:
             return
         if hb.view > self._view:
             self._handler.sync()

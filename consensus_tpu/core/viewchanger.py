@@ -486,24 +486,27 @@ class ViewChanger:
         )
 
     def _maybe_jump_ahead(self) -> bool:
-        """PBFT liveness rule: f+1 distinct nodes voting for views beyond
-        our next prove at least one honest replica is ahead — adopt the
-        SMALLEST such view, so diverged next-views re-converge instead of
-        each replica escalating alone (a stall the randomized soak found:
-        next-views 6/15/15/16 with no quorum possible for any of them)."""
-        views_ahead = self._nvs.views_above(self.next_view)
-        if not views_ahead:
+        """PBFT laggard rule: f+1 distinct nodes voting for the SAME view
+        beyond our next prove at least one honest replica wants that exact
+        view — adopt the smallest such view, so diverged next-views
+        re-converge instead of each replica escalating alone (a stall the
+        randomized soak found: next-views 6/15/15/16 — view 15 carries the
+        f+1 votes).  The threshold is per-view, not a union across views:
+        f Byzantine votes for view X plus one honest vote for a different
+        view Y must not drag us to X, a view with zero honest support
+        (recovery from that relies solely on timeout escalation)."""
+        target = None
+        for view in self._nvs.views_above(self.next_view):
+            voters = self._nvs.voters_of(view)
+            voters.discard(self.self_id)
+            if len(voters) >= self.f + 1:
+                target = view
+                break
+        if target is None:
             return False
-        senders_ahead: set[int] = set()
-        for view in views_ahead:
-            senders_ahead |= self._nvs.voters_of(view)
-        senders_ahead.discard(self.self_id)
-        if len(senders_ahead) < self.f + 1:
-            return False
-        target = views_ahead[0]
         logger.info(
-            "%d: %d nodes vote for views beyond %d — jumping to view change %d",
-            self.self_id, len(senders_ahead), self.next_view, target,
+            "%d: f+1 nodes vote for view %d beyond %d — jumping ahead",
+            self.self_id, target, self.next_view,
         )
         # A live embedded in-flight view belongs to the abandoned change; a
         # late decide from it must not install the jumped-to view without a
@@ -714,18 +717,22 @@ class ViewChanger:
         """Parity: reference viewchanger.go:747-785."""
         if len(self._view_data_votes) < self.quorum:
             return
-        messages = [
-            decode_view_data(svd.raw_view_data)
-            for svd in self._view_data_votes.values()
-        ]
-        ok, _, _ = check_in_flight(messages, self.f, self.quorum)
-        if not ok:
-            logger.info("%d: in-flight check not yet satisfiable", self.self_id)
-            return
-        my_msg = self._prepare_view_data()  # may have changed since
+        # Assemble the ACTUAL broadcast set first — a fresh own ViewData
+        # (it may have changed since registration, e.g. a one-ahead decision
+        # delivered in between) plus the other registered votes — and run
+        # check_in_flight on exactly that set.  Followers recompute the check
+        # on the broadcast contents, so checking anything else (like the
+        # registered set with the stale own vote) could assemble a NewView
+        # every follower rejects, wasting the round.
+        my_msg = self._prepare_view_data()
         signed = [my_msg] + [
             svd for s, svd in self._view_data_votes.items() if s != self.self_id
         ]
+        final_msgs = [decode_view_data(svd.raw_view_data) for svd in signed]
+        ok, _, _ = check_in_flight(final_msgs, self.f, self.quorum)
+        if not ok:
+            logger.info("%d: in-flight check not yet satisfiable", self.self_id)
+            return
         new_view = NewView(signed_view_data=tuple(signed))
         self._comm.broadcast(new_view)
         self._view_data_votes = {}

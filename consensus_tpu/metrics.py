@@ -11,12 +11,17 @@ the default is no-op.
 from __future__ import annotations
 
 import abc
-from typing import Optional
+from typing import Optional, Sequence
 
 
 class Counter(abc.ABC):
     @abc.abstractmethod
     def add(self, delta: float = 1.0) -> None: ...
+
+    def with_labels(self, *values: str) -> "Counter":
+        """Bind label values (embedder dimensions, e.g. channel).  Parity:
+        reference pkg/metrics Counter.With."""
+        return self
 
 
 class Gauge(abc.ABC):
@@ -26,23 +31,47 @@ class Gauge(abc.ABC):
     @abc.abstractmethod
     def add(self, delta: float = 1.0) -> None: ...
 
+    def with_labels(self, *values: str) -> "Gauge":
+        return self
+
 
 class Histogram(abc.ABC):
     @abc.abstractmethod
     def observe(self, value: float) -> None: ...
+
+    def with_labels(self, *values: str) -> "Histogram":
+        return self
+
+
+def extend_label_names(
+    base: Sequence[str], extra: Sequence[str]
+) -> tuple[str, ...]:
+    """Embedder label names appended to an instrument's own, extras sorted —
+    the reference applies the same merge to every bundle so embedders can add
+    per-channel dimensions.  ``with_labels`` values must follow this sorted
+    order (same contract as the reference's makeStatsdFormat, which sorts
+    names before appending).  Parity: reference pkg/api/metrics.go:16-68
+    (NewGaugeOpts / makeLabelNames / makeStatsdFormat)."""
+    return tuple(base) + tuple(sorted(extra))
 
 
 class Provider(abc.ABC):
     """Parity: reference pkg/metrics/provider.go:11-18."""
 
     @abc.abstractmethod
-    def new_counter(self, name: str, help: str = "") -> Counter: ...
+    def new_counter(
+        self, name: str, help: str = "", label_names: Sequence[str] = ()
+    ) -> Counter: ...
 
     @abc.abstractmethod
-    def new_gauge(self, name: str, help: str = "") -> Gauge: ...
+    def new_gauge(
+        self, name: str, help: str = "", label_names: Sequence[str] = ()
+    ) -> Gauge: ...
 
     @abc.abstractmethod
-    def new_histogram(self, name: str, help: str = "") -> Histogram: ...
+    def new_histogram(
+        self, name: str, help: str = "", label_names: Sequence[str] = ()
+    ) -> Histogram: ...
 
 
 class _NoopInstrument(Counter, Gauge, Histogram):
@@ -61,18 +90,22 @@ class NoopProvider(Provider):
 
     _instrument = _NoopInstrument()
 
-    def new_counter(self, name: str, help: str = "") -> Counter:
+    def new_counter(self, name, help="", label_names=()) -> Counter:
         return self._instrument
 
-    def new_gauge(self, name: str, help: str = "") -> Gauge:
+    def new_gauge(self, name, help="", label_names=()) -> Gauge:
         return self._instrument
 
-    def new_histogram(self, name: str, help: str = "") -> Histogram:
+    def new_histogram(self, name, help="", label_names=()) -> Histogram:
         return self._instrument
 
 
 class _MemInstrument(Counter, Gauge, Histogram):
-    def __init__(self) -> None:
+    def __init__(self, provider: "InMemoryProvider", name: str,
+                 label_names: tuple[str, ...] = ()) -> None:
+        self._provider = provider
+        self._name = name
+        self.label_names = label_names
         self.value = 0.0
         self.observations: list[float] = []
 
@@ -85,6 +118,20 @@ class _MemInstrument(Counter, Gauge, Histogram):
     def observe(self, value: float) -> None:
         self.observations.append(value)
 
+    def with_labels(self, *values: str) -> "_MemInstrument":
+        """A child instrument keyed ``name{v1,v2}`` — one series per label
+        value set, like a Prometheus vector."""
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self._name}: {len(self.label_names)} label(s) expected, "
+                f"got {len(values)}"
+            )
+        if not values:
+            return self
+        return self._provider._get(
+            "%s{%s}" % (self._name, ",".join(values)), ()
+        )
+
 
 class InMemoryProvider(Provider):
     """Collects values in plain dicts — for tests and the bench harness."""
@@ -92,17 +139,22 @@ class InMemoryProvider(Provider):
     def __init__(self) -> None:
         self.instruments: dict[str, _MemInstrument] = {}
 
-    def _get(self, name: str) -> _MemInstrument:
-        return self.instruments.setdefault(name, _MemInstrument())
+    def _get(self, name: str, label_names=()) -> _MemInstrument:
+        inst = self.instruments.get(name)
+        if inst is None:
+            inst = self.instruments[name] = _MemInstrument(
+                self, name, tuple(label_names)
+            )
+        return inst
 
-    def new_counter(self, name: str, help: str = "") -> Counter:
-        return self._get(name)
+    def new_counter(self, name, help="", label_names=()) -> Counter:
+        return self._get(name, label_names)
 
-    def new_gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get(name)
+    def new_gauge(self, name, help="", label_names=()) -> Gauge:
+        return self._get(name, label_names)
 
-    def new_histogram(self, name: str, help: str = "") -> Histogram:
-        return self._get(name)
+    def new_histogram(self, name, help="", label_names=()) -> Histogram:
+        return self._get(name, label_names)
 
     def value(self, name: str) -> float:
         # Strict read: a misspelled/unwired name fails instead of
@@ -116,98 +168,146 @@ class InMemoryProvider(Provider):
 # --- instrument bundles (names mirror reference pkg/api/metrics.go) --------
 
 
-class MetricsRequestPool:
+class _Bundle:
+    """Shared label plumbing: ``with_labels`` returns a copy of the bundle
+    with every instrument bound to the given label values.  Parity:
+    reference pkg/api/metrics.go With() on each bundle."""
+
+    def with_labels(self, *values: str) -> "_Bundle":
+        import copy
+
+        clone = copy.copy(self)
+        for k, v in vars(self).items():
+            if isinstance(v, (Counter, Gauge, Histogram)):
+                setattr(clone, k, v.with_labels(*values))
+        return clone
+
+
+class MetricsWAL(_Bundle):
+    """Parity: reference pkg/wal/metrics.go:8-37 (1 instrument)."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_of_files = p.new_gauge(
+            "wal_count_of_files", "Count of wal-files.", ln
+        )
+        self.count_of_files.add(0)  # reference Initialize()
+
+
+class MetricsRequestPool(_Bundle):
     """Parity: reference pkg/api/metrics.go:172-237 (7 instruments)."""
 
-    def __init__(self, p: Provider) -> None:
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
         self.count_of_elements = p.new_gauge(
-            "pool_count_of_elements", "Number of elements in the consensus request pool."
+            "pool_count_of_elements", "Number of elements in the consensus request pool.", ln
         )
         self.count_of_elements_all = p.new_counter(
-            "pool_count_of_elements_all", "Total amount of elements in the pool."
+            "pool_count_of_elements_all", "Total amount of elements in the pool.", ln
         )
         self.count_of_fail_add_request = p.new_counter(
-            "pool_count_of_fail_add_request", "Submissions the pool rejected."
+            "pool_count_of_fail_add_request", "Submissions the pool rejected.", ln
         )
         self.count_of_delete_request = p.new_counter(
-            "pool_count_of_delete_request", "Elements removed from the pool."
+            "pool_count_of_delete_request", "Elements removed from the pool.", ln
         )
         self.count_leader_forward_request = p.new_counter(
-            "pool_count_leader_forward_request", "Requests forwarded to the leader."
+            "pool_count_leader_forward_request", "Requests forwarded to the leader.", ln
         )
         self.count_timeout_two_step = p.new_counter(
-            "pool_count_timeout_two_step", "Complaint-stage timeouts."
+            "pool_count_timeout_two_step", "Complaint-stage timeouts.", ln
         )
         self.latency_of_elements = p.new_histogram(
-            "pool_latency_of_elements", "Time requests spend in the pool."
+            "pool_latency_of_elements", "Time requests spend in the pool.", ln
         )
 
 
-class MetricsBlacklist:
+class MetricsBlacklist(_Bundle):
     """Parity: reference pkg/api/metrics.go:258-297 (2 instruments)."""
 
-    def __init__(self, p: Provider) -> None:
-        self.count = p.new_gauge("blacklist_count", "Nodes in the blacklist.")
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count = p.new_gauge(
+            "blacklist_count", "Nodes in the blacklist.", ln
+        )
         self.node_id_in_blacklist = p.new_gauge(
-            "node_id_in_blacklist", "Whether this node id is blacklisted."
+            "node_id_in_blacklist", "Whether this node id is blacklisted.", ln
         )
 
 
-class MetricsConsensus:
+class MetricsConsensus(_Bundle):
     """Parity: reference pkg/api/metrics.go:319-344 (2 instruments)."""
 
-    def __init__(self, p: Provider) -> None:
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
         self.count_consensus_reconfig = p.new_counter(
-            "consensus_reconfig", "Reconfigurations applied."
+            "consensus_reconfig", "Reconfigurations applied.", ln
         )
         self.latency_sync = p.new_histogram(
-            "consensus_latency_sync", "Duration of synchronization rounds."
+            "consensus_latency_sync", "Duration of synchronization rounds.", ln
         )
 
 
-class MetricsView:
+class MetricsView(_Bundle):
     """Parity: reference pkg/api/metrics.go:448-518 (12 instruments)."""
 
-    def __init__(self, p: Provider) -> None:
-        self.view_number = p.new_gauge("view_number", "Current view number.")
-        self.leader_id = p.new_gauge("view_leader_id", "Current leader id.")
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.view_number = p.new_gauge(
+            "view_number", "Current view number.", ln
+        )
+        self.leader_id = p.new_gauge(
+            "view_leader_id", "Current leader id.", ln
+        )
         self.proposal_sequence = p.new_gauge(
-            "view_proposal_sequence", "In-progress proposal sequence."
+            "view_proposal_sequence", "In-progress proposal sequence.", ln
         )
         self.decisions_in_view = p.new_gauge(
-            "view_decisions", "Decisions made in the current view."
+            "view_decisions", "Decisions made in the current view.", ln
         )
-        self.phase = p.new_gauge("view_phase", "Current 3-phase state.")
+        self.phase = p.new_gauge(
+            "view_phase", "Current 3-phase state.", ln
+        )
         self.count_txs_in_batch = p.new_gauge(
-            "view_count_txs_in_batch", "Transactions in the current batch."
+            "view_count_txs_in_batch", "Transactions in the current batch.", ln
         )
         self.count_batch_all = p.new_counter(
-            "view_count_batch_all", "Batches decided in total."
+            "view_count_batch_all", "Batches decided in total.", ln
         )
         self.count_txs_all = p.new_counter(
-            "view_count_txs_all", "Transactions decided in total."
+            "view_count_txs_all", "Transactions decided in total.", ln
         )
-        self.size_of_batch = p.new_counter("view_size_batch", "Decided bytes in total.")
+        self.size_of_batch = p.new_counter(
+            "view_size_batch", "Decided bytes in total.", ln
+        )
         self.latency_batch_processing = p.new_histogram(
-            "view_latency_batch_processing", "Pre-prepare to commit latency."
+            "view_latency_batch_processing", "Pre-prepare to commit latency.", ln
         )
         self.latency_batch_save = p.new_histogram(
-            "view_latency_batch_save", "Application delivery latency."
+            "view_latency_batch_save", "Application delivery latency.", ln
         )
         self.count_batch_sig_verifications = p.new_counter(
             "view_count_batch_sig_verifications",
             "Signature verifications drained into device batches "
             "(consensus_tpu addition: the TPU offload volume).",
+            ln,
         )
 
 
-class MetricsViewChange:
+class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
-    def __init__(self, p: Provider) -> None:
-        self.current_view = p.new_gauge("viewchange_current_view", "View-changer current view.")
-        self.next_view = p.new_gauge("viewchange_next_view", "View being changed to.")
-        self.real_view = p.new_gauge("viewchange_real_view", "Last installed view.")
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.current_view = p.new_gauge(
+            "viewchange_current_view", "View-changer current view.", ln
+        )
+        self.next_view = p.new_gauge(
+            "viewchange_next_view", "View being changed to.", ln
+        )
+        self.real_view = p.new_gauge(
+            "viewchange_real_view", "Last installed view.", ln
+        )
 
 
 class Metrics:
@@ -215,14 +315,32 @@ class Metrics:
 
     Parity: reference pkg/api/metrics.go:70-104."""
 
-    def __init__(self, provider: Optional[Provider] = None) -> None:
+    def __init__(
+        self,
+        provider: Optional[Provider] = None,
+        label_names: Sequence[str] = (),
+    ) -> None:
         provider = provider or NoopProvider()
         self.provider = provider
-        self.request_pool = MetricsRequestPool(provider)
-        self.blacklist = MetricsBlacklist(provider)
-        self.consensus = MetricsConsensus(provider)
-        self.view = MetricsView(provider)
-        self.view_change = MetricsViewChange(provider)
+        self.request_pool = MetricsRequestPool(provider, label_names)
+        self.blacklist = MetricsBlacklist(provider, label_names)
+        self.consensus = MetricsConsensus(provider, label_names)
+        self.view = MetricsView(provider, label_names)
+        self.view_change = MetricsViewChange(provider, label_names)
+        self.wal = MetricsWAL(provider, label_names)
+
+    def with_labels(self, *values: str) -> "Metrics":
+        """Bind embedder label values on every bundle (e.g. the channel id).
+        Values are positional in SORTED label-name order (the order
+        ``extend_label_names`` stores them).  Parity: reference per-bundle
+        With()."""
+        import copy
+
+        clone = copy.copy(self)
+        for k, v in vars(self).items():
+            if isinstance(v, _Bundle):
+                setattr(clone, k, v.with_labels(*values))
+        return clone
 
 
 __all__ = [
@@ -238,4 +356,6 @@ __all__ = [
     "MetricsConsensus",
     "MetricsView",
     "MetricsViewChange",
+    "MetricsWAL",
+    "extend_label_names",
 ]

@@ -73,8 +73,23 @@ def verify_impl(
     constant; j*(-A) is built per batch with 14 additions.  Lookups are
     one-hot contractions (no gathers), and digit 0 adds the identity — the
     complete addition formulas make that branch-free."""
-    r_point, r_ok = ed.decompress(y_r, sign_r)
-    a_point, a_ok = ed.decompress(y_a, sign_a)
+    # Decompress R and A in ONE instance of the (large) decompression graph
+    # by stacking them along the trailing batch axis — same total runtime
+    # work, half the traced/compiled graph.
+    batch = y_r.shape[-1]
+    pt, pt_ok = ed.decompress(
+        jnp.concatenate([y_r, y_a], axis=-1),
+        jnp.concatenate([sign_r, sign_a], axis=-1),
+    )
+    r_point = ed.Point(
+        x=pt.x[..., :batch], y=pt.y[..., :batch],
+        z=pt.z[..., :batch], t=pt.t[..., :batch],
+    )
+    a_point = ed.Point(
+        x=pt.x[..., batch:], y=pt.y[..., batch:],
+        z=pt.z[..., batch:], t=pt.t[..., batch:],
+    )
+    r_ok, a_ok = pt_ok[..., :batch], pt_ok[..., batch:]
     neg_a = ed.negate(a_point)
     # *_like / table coords inherit the inputs' sharding variance so the
     # scan carry type-checks under shard_map.
@@ -87,9 +102,11 @@ def verify_impl(
         s_d, k_d = window  # (batch,) digit indices
         s_oh = (s_d[None] == lanes).astype(jnp.float32)  # (16, batch)
         k_oh = (k_d[None] == lanes).astype(jnp.float32)
-        acc = ed.double(acc, need_t=False)
-        acc = ed.double(acc, need_t=False)
-        acc = ed.double(acc, need_t=False)
+        # 3 T-free doubles as an inner scan (one body in the graph) + the
+        # final T-producing double — graph size, not runtime, economy.
+        acc, _ = jax.lax.scan(
+            lambda a, _: (ed.double(a, need_t=False), None), acc, None, length=3
+        )
         acc = ed.double(acc)
         acc = ed.add(acc, ed.table_lookup(base_table, s_oh))
         acc = ed.add(acc, ed.table_lookup(a_table, k_oh))
@@ -269,20 +286,49 @@ class Ed25519BatchVerifier:
         return np.asarray(result)[:n]
 
     @staticmethod
-    def _verify_host(messages, signatures, public_keys) -> np.ndarray:
-        """Sequential host fallback via the ``cryptography`` package."""
+    def _canonical_ok(signatures, public_keys) -> np.ndarray:
+        """The device kernel's host-side pre-checks, standalone: sig length,
+        S < L (RFC 8032 §5.1.7 malleability), and canonical compressed
+        encodings (y < p) for both R and A."""
+        n = len(signatures)
+        ok = np.ones(n, dtype=bool)
+        for i in range(n):
+            sig, key = signatures[i], public_keys[i]
+            if len(sig) != 64 or len(key) != 32:
+                ok[i] = False
+                continue
+            if int.from_bytes(sig[32:], "little") >= L:
+                ok[i] = False
+                continue
+            y_r = int.from_bytes(sig[:32], "little") & ((1 << 255) - 1)
+            y_a = int.from_bytes(key, "little") & ((1 << 255) - 1)
+            if y_r >= fe.P or y_a >= fe.P:
+                ok[i] = False
+        return ok
+
+    @classmethod
+    def _verify_host(cls, messages, signatures, public_keys) -> np.ndarray:
+        """Sequential host fallback via the ``cryptography`` package.
+
+        Ed25519 verifiers disagree on adversarial edge cases (non-canonical
+        encodings, S >= L), and in BFT a vote's validity must not depend on
+        which replica (or batch size) checked it — so the device kernel's
+        strict pre-checks run here too, and all replicas must use identical
+        verifier config (min_device_batch included in quorum-relevant
+        paths only via config parity)."""
         from cryptography.exceptions import InvalidSignature
         from cryptography.hazmat.primitives.asymmetric.ed25519 import (
             Ed25519PublicKey,
         )
 
-        out = np.zeros(len(messages), dtype=bool)
+        out = cls._canonical_ok(signatures, public_keys)
         for i, (msg, sig, key) in enumerate(zip(messages, signatures, public_keys)):
+            if not out[i]:
+                continue
             try:
                 Ed25519PublicKey.from_public_bytes(bytes(key)).verify(
                     bytes(sig), bytes(msg)
                 )
-                out[i] = True
             except (InvalidSignature, ValueError):
                 out[i] = False
         return out

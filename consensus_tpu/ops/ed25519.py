@@ -216,15 +216,24 @@ def table_lookup(table: Point, one_hot: jnp.ndarray) -> Point:
 def multiples_table(p: Point, size: int = 16) -> Point:
     """j*p for j = 0..size-1, coords stacked on a leading axis (identity
     first, so digit 0 adds the neutral element — the unified formulas make
-    that a plain add, no branch)."""
-    entries = [identity_like(p.x), p]
-    for _ in range(size - 2):
-        entries.append(add(entries[-1], p))
+    that a plain add, no branch).
+
+    Built with a ``lax.scan`` so the add formula appears ONCE in the graph
+    regardless of table size — inlining size-2 point adds was a measured
+    chunk of the kernel's trace+compile time."""
+    import jax
+
+    def step(prev: Point, _):
+        nxt = add(prev, p)
+        return nxt, nxt
+
+    _, rest = jax.lax.scan(step, p, None, length=size - 2)
+    ident = identity_like(p.x)
     return Point(
-        x=jnp.stack([e.x for e in entries]),
-        y=jnp.stack([e.y for e in entries]),
-        z=jnp.stack([e.z for e in entries]),
-        t=jnp.stack([e.t for e in entries]),
+        x=jnp.concatenate([ident.x[None], p.x[None], rest.x]),
+        y=jnp.concatenate([ident.y[None], p.y[None], rest.y]),
+        z=jnp.concatenate([ident.z[None], p.z[None], rest.z]),
+        t=jnp.concatenate([ident.t[None], p.t[None], rest.t]),
     )
 
 

@@ -33,6 +33,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from consensus_tpu.ops.limbs import carry_i32
+
 LIMBS = 32
 LIMB_BITS = 8
 BASE = 256.0
@@ -216,15 +218,9 @@ _P_LIMBS_I32 = np.array(
 _TWO_P_I32 = _TWO_P.astype(np.int32)
 
 
-def _carry_i32(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+def _carry_i32(x):
     """Exact sequential int32 carry pass (freeze-only path)."""
-    out = []
-    carry = jnp.zeros_like(x[0])
-    for k in range(LIMBS):
-        v = x[k] + carry
-        out.append(v & 0xFF)
-        carry = v >> LIMB_BITS
-    return jnp.stack(out, axis=0), carry
+    return carry_i32(x, LIMB_BITS)
 
 
 def freeze(a: jnp.ndarray) -> jnp.ndarray:

@@ -21,6 +21,8 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from consensus_tpu.ops.limbs import carry_i32
+
 LIMBS = 32
 LIMB_BITS = 8
 BASE = 256.0
@@ -199,36 +201,27 @@ _P_LIMBS_I32 = np.array(
 )
 
 
+def _carry_i32(x):
+    """Exact sequential int32 carry pass (freeze-only path)."""
+    return carry_i32(x, LIMB_BITS)
+
+
 def freeze(a: jnp.ndarray) -> jnp.ndarray:
     """Canonical int32 representative in [0, p)."""
     x = jnp.asarray(jnp.rint(a), dtype=jnp.int32)
-    x = x + jnp.reshape(jnp.asarray(_get_bias().astype(np.int32) * 0), x.shape[:1] + (1,) * (x.ndim - 1))  # no-op keep dtype
     # Bias to positive using the signed multiple of p, then carry exactly.
     x = x + jnp.reshape(jnp.asarray(_get_bias().astype(np.int32)), (LIMBS,) + (1,) * (a.ndim - 1))
     # Sequential exact carry; value in (0, ~2^263): top carry folds via the
     # Solinas pattern (iterate twice — the first fold's carry is tiny).
     for _ in range(2):
-        out = []
-        carry = jnp.zeros_like(x[0])
-        for k in range(LIMBS):
-            v = x[k] + carry
-            out.append(v & 0xFF)
-            carry = v >> LIMB_BITS
-        x = jnp.stack(out, axis=0)
+        x, carry = _carry_i32(x)
         for pos, sign in _FOLD_PATTERN:
             x = x.at[pos].add(sign * carry)
     p_e = jnp.reshape(jnp.asarray(_P_LIMBS_I32), (LIMBS,) + (1,) * (a.ndim - 1))
     for _ in range(3):
         # Subtract p while the value still exceeds it (value < ~2^256 + eps
         # after the carry folds; p ~ 2^256 (1 - 2^-32), so <= 3 rounds).
-        d = x - p_e
-        out = []
-        carry = jnp.zeros_like(x[0])
-        for k in range(LIMBS):
-            v = d[k] + carry
-            out.append(v & 0xFF)
-            carry = v >> LIMB_BITS
-        d = jnp.stack(out, axis=0)
+        d, carry = _carry_i32(x - p_e)
         ge_p = carry == 0
         x = jnp.where(ge_p[None], d, x)
     return x

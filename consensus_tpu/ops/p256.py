@@ -157,13 +157,20 @@ def table_lookup(table: Point, one_hot: jnp.ndarray) -> Point:
 
 
 def multiples_table(p: Point, size: int = 16) -> Point:
-    entries = [identity_like(p.x), p]
-    for _ in range(size - 2):
-        entries.append(add(entries[-1], p))
+    """Built with a ``lax.scan`` so the add formula appears once in the
+    graph regardless of table size (compile-time, not runtime, economy)."""
+    import jax
+
+    def step(prev: Point, _):
+        nxt = add(prev, p)
+        return nxt, nxt
+
+    _, rest = jax.lax.scan(step, p, None, length=size - 2)
+    ident = identity_like(p.x)
     return Point(
-        x=jnp.stack([e.x for e in entries]),
-        y=jnp.stack([e.y for e in entries]),
-        z=jnp.stack([e.z for e in entries]),
+        x=jnp.concatenate([ident.x[None], p.x[None], rest.x]),
+        y=jnp.concatenate([ident.y[None], p.y[None], rest.y]),
+        z=jnp.concatenate([ident.z[None], p.z[None], rest.z]),
     )
 
 

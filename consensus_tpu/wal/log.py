@@ -110,6 +110,7 @@ class WriteAheadLog:
         sync: bool = True,
         group_commit_window: float = 0.0,
         scheduler=None,
+        metrics=None,
     ) -> None:
         """``group_commit_window`` > 0 (requires a ``scheduler``) batches
         fsyncs: appends write immediately but durability callbacks are
@@ -123,6 +124,9 @@ class WriteAheadLog:
             raise ValueError("group_commit_window requires a scheduler")
         if group_commit_window > 0 and not sync:
             raise ValueError("group_commit_window is meaningless with sync=False")
+        #: Optional MetricsWAL bundle; gauge parity: reference
+        #: pkg/wal/metrics.go:8-15 (wal_count_of_files).
+        self._metrics = metrics
         self._dir = directory
         self._segment_max_bytes = segment_max_bytes
         self._sync = sync
@@ -302,6 +306,17 @@ class WriteAheadLog:
             else:
                 os.fsync(self._file.fileno())
 
+    def attach_metrics(self, metrics) -> None:
+        """Attach a MetricsWAL bundle after construction (the facade calls
+        this: the embedder builds the WAL before the metrics provider is
+        known) and publish the current file count."""
+        self._metrics = metrics
+        self._update_file_count()
+
+    def _update_file_count(self) -> None:
+        if self._metrics is not None:
+            self._metrics.count_of_files.set(len(_list_segments(self._dir)))
+
     def _start_segment(self, index: int) -> None:
         if self._file is not None:
             self._file.flush()
@@ -317,6 +332,7 @@ class WriteAheadLog:
         self._write_record(_TYPE_ANCHOR, 0, anchor_data)
         if self._sync:
             _fsync_dir(self._dir)
+        self._update_file_count()
 
     def _drop_old_segments(self) -> None:
         self._drop_segments_below(self._segment_index)
@@ -327,6 +343,7 @@ class WriteAheadLog:
                 os.unlink(os.path.join(self._dir, name))
         if self._sync:
             _fsync_dir(self._dir)
+        self._update_file_count()
 
     # --- reading -----------------------------------------------------------
 

@@ -23,3 +23,14 @@ import jax  # noqa: E402  (already imported at startup; this is a no-op)
 # wedged tunnel then hangs even CPU-only tests.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_platform_name", "cpu")
+
+# Persistent compilation cache: the big verify graphs cost tens of seconds
+# of XLA CPU compile per process — cache them across test runs (repo-local,
+# gitignored) so the full suite fits in a driver budget.  One definition of
+# the cache settings lives in __graft_entry__ (repo root).
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _enable_compile_cache  # noqa: E402
+
+_enable_compile_cache()

@@ -268,6 +268,36 @@ class TestBatchVerifier:
         host = Ed25519BatchVerifier(min_device_batch=100).verify_batch(msgs, bad, keys)
         assert (device == host).all()
 
+    def test_host_and_device_agree_on_edge_case_vectors(self):
+        """Known adversarial classes where Ed25519 verifiers diverge
+        (non-canonical encodings, S >= L, small-order components): in BFT a
+        vote's validity must not depend on which path checked it, so the
+        host fallback applies the device kernel's strict pre-checks
+        (ADVICE r2: models/ed25519.py:246)."""
+        from consensus_tpu.models.ed25519 import L
+        from consensus_tpu.ops.field25519 import P
+
+        msgs, sigs, keys = make_sigs(8)
+        # 0: non-canonical R (y >= p): p + 1 little-endian, sign bit clear.
+        sigs[0] = (P + 1).to_bytes(32, "little") + sigs[0][32:]
+        # 1: non-canonical A (y >= p).
+        keys[1] = (P + 2).to_bytes(32, "little")
+        # 2: S = L exactly (malleability boundary).
+        sigs[2] = sigs[2][:32] + L.to_bytes(32, "little")
+        # 3: S = L - 1 but otherwise-wrong signature (range-valid, invalid).
+        sigs[3] = sigs[3][:32] + (L - 1).to_bytes(32, "little")
+        # 4: small-order A: identity point (y=1, x=0).
+        keys[4] = (1).to_bytes(32, "little")
+        # 5: small-order R: identity encoding as R.
+        sigs[5] = (1).to_bytes(32, "little") + sigs[5][32:]
+        # 6: A with y = p - 1 but sign bit set (may be a non-square x^2).
+        keys[6] = bytes(31) + b"\x80"  # y=0, sign=1
+        # 7: left valid as a control.
+        device = Ed25519BatchVerifier(min_device_batch=1).verify_batch(msgs, sigs, keys)
+        host = Ed25519BatchVerifier(min_device_batch=100).verify_batch(msgs, sigs, keys)
+        assert (device == host).all(), (device, host)
+        assert device[7] and not device[:3].any()
+
 
 class _Ed25519OnlyVerifier(Ed25519VerifierMixin):
     """Concrete mixin instance for the signature-path tests."""

@@ -23,3 +23,78 @@ def test_metrics_record_protocol_activity():
     assert len(provider.observations("view_latency_batch_save")) == 3
     assert provider.value("view_proposal_sequence") >= 3
     assert provider.value("view_number") == 0
+
+
+def test_instrument_name_parity_with_reference():
+    """Every instrument name from reference pkg/api/metrics.go +
+    pkg/wal/metrics.go exists under the same name here."""
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+
+    reference_names = {
+        # pkg/api/metrics.go — request pool (7)
+        "pool_count_of_elements", "pool_count_of_elements_all",
+        "pool_count_of_fail_add_request", "pool_count_of_delete_request",
+        "pool_count_leader_forward_request", "pool_count_timeout_two_step",
+        "pool_latency_of_elements",
+        # blacklist (2)
+        "blacklist_count", "node_id_in_blacklist",
+        # consensus (2)
+        "consensus_reconfig", "consensus_latency_sync",
+        # view (11)
+        "view_number", "view_leader_id", "view_proposal_sequence",
+        "view_decisions", "view_phase", "view_count_txs_in_batch",
+        "view_count_batch_all", "view_count_txs_all", "view_size_batch",
+        "view_latency_batch_processing", "view_latency_batch_save",
+        # view change (3)
+        "viewchange_current_view", "viewchange_next_view", "viewchange_real_view",
+        # pkg/wal/metrics.go (1)
+        "wal_count_of_files",
+    }
+    provider = InMemoryProvider()
+    Metrics(provider)
+    missing = reference_names - set(provider.instruments)
+    assert not missing, f"reference instruments absent: {sorted(missing)}"
+
+
+def test_label_extension_per_channel():
+    """Embedder label dimensions (reference pkg/api/metrics.go:16-68):
+    with_labels binds values, series are tracked independently."""
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+
+    provider = InMemoryProvider()
+    base = Metrics(provider, label_names=("channel",))
+    ch1, ch2 = base.with_labels("ch1"), base.with_labels("ch2")
+    ch1.view.view_number.set(4)
+    ch2.view.view_number.set(9)
+    ch1.wal.count_of_files.add(2)
+    assert provider.value("view_number{ch1}") == 4
+    assert provider.value("view_number{ch2}") == 9
+    assert provider.value("wal_count_of_files{ch1}") == 2
+    # Wrong arity fails loudly.
+    import pytest
+    with pytest.raises(ValueError):
+        base.view.view_number.with_labels("a", "b")
+
+
+def test_wal_file_count_gauge():
+    """wal_count_of_files tracks segment rollover and retention-driven
+    deletion.  Parity: reference pkg/wal/metrics.go:8-15."""
+    import tempfile
+
+    from consensus_tpu.metrics import InMemoryProvider, MetricsWAL
+    from consensus_tpu.wal.log import WriteAheadLog
+
+    provider = InMemoryProvider()
+    with tempfile.TemporaryDirectory() as d:
+        wal = WriteAheadLog.create(
+            d, metrics=MetricsWAL(provider), segment_max_bytes=256, sync=False
+        )
+        assert provider.value("wal_count_of_files") == 1
+        for _ in range(20):
+            wal.append(b"x" * 64)
+        grown = provider.value("wal_count_of_files")
+        assert grown > 1
+        # truncate_to retention: drops all segments below the current one.
+        wal.append(b"y" * 64, truncate_to=True)
+        assert provider.value("wal_count_of_files") <= 2
+        wal.close()
