@@ -61,6 +61,11 @@ class Ed25519Signer(Signer):
             serialization.Encoding.Raw, serialization.PublicFormat.Raw
         )
 
+    def sign_raw(self, data: bytes) -> bytes:
+        """Sign ``data`` exactly as given (no domain tag) — for embedders
+        that bring their own message framing (e.g. client requests)."""
+        return self._key.sign(data)
+
     def sign(self, data: bytes) -> bytes:
         return self._key.sign(raw_message(data))
 
@@ -146,13 +151,17 @@ class EcdsaP256Signer(Signer):
             serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
         )
 
-    def _sign_raw(self, data: bytes) -> bytes:
+    def sign_raw(self, data: bytes) -> bytes:
+        """Sign ``data`` exactly as given (no domain tag); returns the
+        framework's raw 64-byte r||s format."""
         from consensus_tpu.models.ecdsa_p256 import raw_signature_from_der
 
         return raw_signature_from_der(self._key.sign(data, self._hash))
 
+    _sign_raw = sign_raw  # backward-compat internal alias
+
     def sign(self, data: bytes) -> bytes:
-        return self._sign_raw(raw_message(data))
+        return self.sign_raw(raw_message(data))
 
     def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature:
         return Signature(

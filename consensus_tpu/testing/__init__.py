@@ -13,9 +13,13 @@ from consensus_tpu.testing.app import (
     pack_batch,
     unpack_batch,
 )
+from consensus_tpu.testing.crypto_app import ClientKeyring, CryptoApp, SignedRequestApp
 from consensus_tpu.testing.network import NodeComm, SimNetwork
 
 __all__ = [
+    "ClientKeyring",
+    "CryptoApp",
+    "SignedRequestApp",
     "Cluster",
     "Node",
     "TestApp",

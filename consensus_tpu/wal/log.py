@@ -177,6 +177,7 @@ class WriteAheadLog:
         path = os.path.join(directory, last_name)
         wal._file = open(path, "ab")
         wal._segment_index = last_index
+        wal._update_file_count()
         return wal
 
     def close(self) -> None:

@@ -10,6 +10,7 @@ import numpy as np
 
 from consensus_tpu.models import Ed25519BatchVerifier, Ed25519Signer, Ed25519VerifierMixin
 from consensus_tpu.testing import Cluster, TestApp, make_request
+from consensus_tpu.testing.crypto_app import CryptoApp
 
 
 class CountingEngine(Ed25519BatchVerifier):
@@ -24,33 +25,6 @@ class CountingEngine(Ed25519BatchVerifier):
         return super().verify_batch(messages, signatures, public_keys)
 
 
-class CryptoApp(TestApp):
-    """TestApp with the trivial crypto swapped for real Ed25519."""
-
-    def __init__(self, node_id, cluster, signer, verifier):
-        super().__init__(node_id, cluster)
-        self._signer = signer
-        self._verifier = verifier
-
-    # Signer
-    def sign(self, data):
-        return self._signer.sign(data)
-
-    def sign_proposal(self, proposal, aux=b""):
-        return self._signer.sign_proposal(proposal, aux)
-
-    # Verifier signature paths
-    def verify_consenter_sig(self, signature, proposal):
-        return self._verifier.verify_consenter_sig(signature, proposal)
-
-    def verify_consenter_sigs_batch(self, signatures, proposal):
-        return self._verifier.verify_consenter_sigs_batch(signatures, proposal)
-
-    def verify_signature(self, signature):
-        return self._verifier.verify_signature(signature)
-
-    def auxiliary_data(self, msg):
-        return self._verifier.auxiliary_data(msg)
 
 
 class _SigVerifier(Ed25519VerifierMixin):
@@ -124,3 +98,43 @@ def test_forged_commit_rejected_by_real_crypto():
         assert 4 not in {s.id for s in decision.signatures}, (
             "forged signature entered the quorum"
         )
+
+
+def test_signed_requests_batch_verified_per_proposal():
+    """SignedRequestApp: client-request signatures are verified as ONE
+    engine batch per proposal (the integrated bench path,
+    benchmarks/chain_crypto_tps.py), and tampered requests are rejected."""
+    import pytest
+
+    from consensus_tpu.models import Ed25519Signer
+    from consensus_tpu.testing import ClientKeyring, Cluster, SignedRequestApp
+
+    cluster = Cluster(4)
+    engine = CountingEngine(min_device_batch=10**9)  # host path: fast, exact
+    signers = {i: Ed25519Signer(i) for i in cluster.nodes}
+    keys = {i: s.public_bytes for i, s in signers.items()}
+    clients = ClientKeyring([Ed25519Signer(100 + i) for i in range(3)])
+    for node_id, node in cluster.nodes.items():
+        node.app = SignedRequestApp(
+            node_id, cluster, signers[node_id], _SigVerifier(keys, engine=engine),
+            client_keys=clients.public_keys, engine=engine,
+        )
+    cluster.start()
+
+    for i in range(2):
+        for c in range(3):
+            cluster.submit_to_all(clients.make_request(c, i))
+        assert cluster.run_until_ledger(i + 1, max_time=300.0)
+    cluster.assert_ledgers_consistent()
+    total_reqs = sum(
+        len(d.proposal.payload) > 0 for d in cluster.nodes[1].app.ledger
+    )
+    assert total_reqs >= 2
+    assert engine.items >= 6  # request sigs actually drained through batches
+
+    # A tampered request never clears ingress.
+    app = cluster.nodes[1].app
+    bad = bytearray(clients.make_request(0, 99))
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        app.verify_request(bytes(bad))
