@@ -1,0 +1,147 @@
+"""Shared benchmark harness: an n-replica cluster over real localhost TCP
+sockets with realtime schedulers, plus the feeder/teardown plumbing.
+
+Used by benchmarks/chain_tps.py (trivial crypto) and
+benchmarks/chain_crypto_tps.py (real signatures).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Optional
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class RealCluster:
+    """App-level cluster state shared by the replicas' Synchronizer ports."""
+
+    def __init__(self):
+        self.nodes = {}
+
+    def longest_ledger(self, *, exclude):
+        best = []
+        for node_id, holder in self.nodes.items():
+            if node_id == exclude or not holder.running:
+                continue
+            if len(holder.app.ledger) > len(best):
+                best = holder.app.ledger
+        return list(best)
+
+    def reconfig_of(self, proposal):
+        from consensus_tpu.types import Reconfig
+
+        return Reconfig()
+
+
+class Holder:
+    def __init__(self, app):
+        self.app = app
+        self.running = True
+
+
+def start_replicas(
+    n: int,
+    make_app: Callable[[int, RealCluster], object],
+    make_config: Callable[[int], object],
+    *,
+    leader_metrics=None,
+):
+    """Boot n replicas over TCP.  Returns (cluster, replicas, comms,
+    schedulers); replica 1 gets ``leader_metrics`` if provided."""
+    from consensus_tpu.consensus import Consensus
+    from consensus_tpu.net import TcpComm
+    from consensus_tpu.runtime import RealtimeScheduler
+    from consensus_tpu.testing.app import MemWAL
+
+    ports = free_ports(n)
+    addrs = {i + 1: ("127.0.0.1", ports[i]) for i in range(n)}
+    cluster = RealCluster()
+    replicas, comms, schedulers = {}, {}, {}
+
+    for node_id in addrs:
+        app = make_app(node_id, cluster)
+        cluster.nodes[node_id] = Holder(app)
+        rt = RealtimeScheduler()
+        rt.start(thread_name=f"replica-{node_id}")
+        schedulers[node_id] = rt
+
+        def make_router(nid):
+            def route(sender, payload, is_request):
+                consensus = replicas.get(nid)
+                if consensus is None:
+                    return
+                if is_request:
+                    consensus.handle_request(sender, payload)
+                else:
+                    consensus.handle_message(sender, payload)
+
+            return route
+
+        comm = TcpComm(node_id, addrs, make_router(node_id), reconnect_backoff=0.05)
+        comm.start()
+        comms[node_id] = comm
+        consensus = Consensus(
+            config=make_config(node_id),
+            scheduler=rt,
+            comm=comm,
+            application=app,
+            assembler=app,
+            wal=MemWAL([]),
+            signer=app,
+            verifier=app,
+            request_inspector=app.inspector,
+            synchronizer=app,
+            metrics=leader_metrics if node_id == 1 else None,
+        )
+        consensus.start()
+        replicas[node_id] = consensus
+
+    return cluster, replicas, comms, schedulers
+
+
+def start_feeder(leader, requests, *, inflight: int):
+    """Feed ``requests`` (an iterable of raw request bytes or a generator)
+    to the leader with semaphore backpressure on a daemon thread.  Returns
+    (stop_event, exhausted: list[bool]) — ``exhausted[0]`` turns True if the
+    request stream ran dry before ``stop_event`` was set (a benchmark that
+    exhausts its stream mid-window is under-measuring)."""
+    stop = threading.Event()
+    exhausted = [False]
+
+    def feeder():
+        sem = threading.Semaphore(inflight)
+
+        def release(err):
+            sem.release()
+
+        for raw in requests:
+            if stop.is_set():
+                return
+            sem.acquire()
+            leader.submit_request(raw, release)
+        exhausted[0] = True
+
+    threading.Thread(target=feeder, daemon=True).start()
+    return stop, exhausted
+
+
+def teardown(replicas, comms, schedulers):
+    for consensus in replicas.values():
+        consensus.stop()
+    for comm in comms.values():
+        comm.stop()
+    for rt in schedulers.values():
+        try:
+            rt.stop(timeout=2.0)
+        except RuntimeError:
+            pass

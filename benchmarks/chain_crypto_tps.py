@@ -23,50 +23,18 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import socket
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmarks._harness import start_feeder, start_replicas, teardown
 
-def free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+_REQ_TAG = b"ctpu/request"
 
 
-class _RealCluster:
-    def __init__(self):
-        self.nodes = {}
-
-    def longest_ledger(self, *, exclude):
-        best = []
-        for node_id, holder in self.nodes.items():
-            if node_id == exclude or not holder.running:
-                continue
-            if len(holder.app.ledger) > len(best):
-                best = holder.app.ledger
-        return list(best)
-
-    def reconfig_of(self, proposal):
-        from consensus_tpu.types import Reconfig
-
-        return Reconfig()
-
-
-class _Holder:
-    def __init__(self, app):
-        self.app = app
-        self.running = True
-
-
-def build_family(family: str, node_ids, n_clients: int, verify_mode: str):
+def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
+                 pad_to: int):
     """Returns (replica signers, verifier factory, engine, client keyring)."""
     from consensus_tpu.models import (
         EcdsaP256Signer,
@@ -81,15 +49,17 @@ def build_family(family: str, node_ids, n_clients: int, verify_mode: str):
     # Host mode = the reference's sequential CPU loop (OpenSSL per sig).
     # Device mode routes small batches (quorum checks, a handful of sigs)
     # to the host too — kernel launch + tunnel latency dominates below
-    # min_device_batch — while proposal-sized batches ride the device.
+    # min_device_batch — and pads every device batch to ONE fixed shape
+    # (pad_to) so no mid-run XLA compile can stall a replica thread.
     min_dev = 10**9 if verify_mode == "host" else 32
+    kw = dict(min_device_batch=min_dev, pad_to=pad_to)
     if family == "ed25519":
-        engine = Ed25519BatchVerifier(min_device_batch=min_dev)
+        engine = Ed25519BatchVerifier(**kw)
         signers = {i: Ed25519Signer(i) for i in node_ids}
         clients = ClientKeyring([Ed25519Signer(1000 + i) for i in range(n_clients)])
         mixin_cls = Ed25519VerifierMixin
     elif family == "p256":
-        engine = EcdsaP256BatchVerifier(min_device_batch=min_dev)
+        engine = EcdsaP256BatchVerifier(**kw)
         signers = {i: EcdsaP256Signer(i) for i in node_ids}
         clients = ClientKeyring([EcdsaP256Signer(1000 + i) for i in range(n_clients)])
         mixin_cls = EcdsaP256VerifierMixin
@@ -117,6 +87,13 @@ def build_family(family: str, node_ids, n_clients: int, verify_mode: str):
     return signers, make_verifier, engine, clients
 
 
+def _next_pow2(n: int, minimum: int = 8) -> int:
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--family", choices=["ed25519", "p256"], default="ed25519")
@@ -125,7 +102,7 @@ def main() -> None:
     ap.add_argument("--verify", choices=["device", "host"], default="device")
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--clients", type=int, default=16)
-    ap.add_argument("--presign", type=int, default=60000)
+    ap.add_argument("--presign", type=int, default=100000)
     ap.add_argument(
         "--platform",
         default=None,
@@ -142,16 +119,13 @@ def main() -> None:
     _enable_compile_cache()
 
     from consensus_tpu.config import Configuration
-    from consensus_tpu.consensus import Consensus
     from consensus_tpu.metrics import InMemoryProvider, Metrics
-    from consensus_tpu.net import TcpComm
-    from consensus_tpu.runtime import RealtimeScheduler
-    from consensus_tpu.testing.app import MemWAL
     from consensus_tpu.testing.crypto_app import SignedRequestApp
 
     node_ids = list(range(1, args.n + 1))
+    pad_to = _next_pow2(args.batch)
     signers, make_verifier, engine, clients = build_family(
-        args.family, node_ids, args.clients, args.verify
+        args.family, node_ids, args.clients, args.verify, pad_to
     )
     sig_len = 64
 
@@ -162,32 +136,26 @@ def main() -> None:
     ]
 
     if args.verify == "device":
-        # Warm the kernel shapes BEFORE consensus starts: a first-compile
-        # stall inside a replica thread trips heartbeat timeouts and the
-        # cluster spends the benchmark in view changes.  Shapes: the padded
-        # proposal batch and the small end of the pow-2 ladder (quorum-sized
-        # batches route to host below min_device_batch).
+        # Warm the ONE kernel shape (pad_to) BEFORE consensus starts: a
+        # first-compile stall inside a replica thread trips heartbeat
+        # timeouts and the cluster spends the benchmark in view changes.
         warm = presigned[: args.batch]
-        infos = [None]
         t0 = time.time()
         raws = [r[:-sig_len] for r in warm]
         sigs = [r[-sig_len:] for r in warm]
         keys = [clients.public_keys[i % args.clients] for i in range(len(warm))]
-        ok = engine.verify_batch([b"ctpu/request" + r for r in raws], sigs, keys)
+        ok = engine.verify_batch([_REQ_TAG + r for r in raws], sigs, keys)
         assert ok.all(), "warmup requests failed to verify"
         print(
-            f"# kernel warm ({len(warm)} sigs) in {time.time()-t0:.1f}s",
+            f"# kernel warm ({len(warm)} sigs -> shape {pad_to}) "
+            f"in {time.time()-t0:.1f}s",
             file=sys.stderr,
         )
 
-    ports = free_ports(args.n)
-    addrs = {i + 1: ("127.0.0.1", ports[i]) for i in range(args.n)}
-    cluster = _RealCluster()
-    replicas, comms, schedulers = {}, {}, {}
     leader_provider = InMemoryProvider()
 
-    for node_id in addrs:
-        app = SignedRequestApp(
+    def make_app(node_id, cluster):
+        return SignedRequestApp(
             node_id,
             cluster,
             signers[node_id],
@@ -196,82 +164,46 @@ def main() -> None:
             engine=engine,
             sig_len=sig_len,
         )
-        cluster.nodes[node_id] = _Holder(app)
-        rt = RealtimeScheduler()
-        rt.start(thread_name=f"replica-{node_id}")
-        schedulers[node_id] = rt
 
-        def make_router(nid):
-            def route(sender, payload, is_request):
-                consensus = replicas.get(nid)
-                if consensus is None:
-                    return
-                if is_request:
-                    consensus.handle_request(sender, payload)
-                else:
-                    consensus.handle_message(sender, payload)
-
-            return route
-
-        comm = TcpComm(node_id, addrs, make_router(node_id), reconnect_backoff=0.05)
-        comm.start()
-        comms[node_id] = comm
-        consensus = Consensus(
-            config=Configuration(
-                self_id=node_id,
-                leader_rotation=False,
-                decisions_per_leader=0,
-                request_batch_max_count=args.batch,
-                request_batch_max_interval=0.02,
-                request_pool_size=max(2000, 3 * args.batch),
-            ),
-            scheduler=rt,
-            comm=comm,
-            application=app,
-            assembler=app,
-            wal=MemWAL([]),
-            signer=app,
-            verifier=app,
-            request_inspector=app.inspector,
-            synchronizer=app,
-            metrics=Metrics(leader_provider) if node_id == 1 else None,
+    def make_config(node_id):
+        return Configuration(
+            self_id=node_id,
+            leader_rotation=False,
+            decisions_per_leader=0,
+            request_batch_max_count=args.batch,
+            request_batch_max_interval=0.02,
+            request_pool_size=max(2000, 3 * args.batch),
         )
-        consensus.start()
-        replicas[node_id] = consensus
+
+    cluster, replicas, comms, schedulers = start_replicas(
+        args.n, make_app, make_config, leader_metrics=Metrics(leader_provider)
+    )
 
     leader = replicas[1]
     ledger = cluster.nodes[1].app.ledger
-    stop = threading.Event()
+    stop, exhausted = start_feeder(
+        leader, presigned, inflight=max(1500, 2 * args.batch)
+    )
 
-    def feeder():
-        inflight = threading.Semaphore(max(1500, 2 * args.batch))
-
-        def release(err):
-            inflight.release()
-
-        i = 0
-        while not stop.is_set() and i < len(presigned):
-            inflight.acquire()
-            leader.submit_request(presigned[i], release)
-            i += 1
-
-    feeder_thread = threading.Thread(target=feeder, daemon=True)
-    feeder_thread.start()
-
-    # Warmup (compiles kernels in device mode), then measure.
+    # Warmup, then measure.
     time.sleep(4.0)
     lat = leader_provider.observations("view_latency_batch_processing")
     start_blocks, start_lat = len(ledger), len(lat)
-    start_tx = sum(
-        int.from_bytes(d.proposal.payload[:4], "big") for d in ledger
-    )
+    start_tx = sum(int.from_bytes(d.proposal.payload[:4], "big") for d in ledger)
     t0 = time.time()
     time.sleep(args.seconds)
     elapsed = time.time() - t0
     end_blocks = len(ledger)
     end_tx = sum(int.from_bytes(d.proposal.payload[:4], "big") for d in ledger)
     window_lat = sorted(lat[start_lat:])
+    ran_dry = exhausted[0]
     stop.set()
+    if ran_dry:
+        print(
+            "# WARNING: presigned request stream ran dry during the window; "
+            "tx/sec under-measures — raise --presign",
+            file=sys.stderr,
+        )
 
     tx_per_sec = (end_tx - start_tx) / elapsed
 
@@ -297,19 +229,12 @@ def main() -> None:
                 "p50_commit_latency_ms": pct(0.50),
                 "p90_commit_latency_ms": pct(0.90),
                 "backend": jax.default_backend(),
+                "presign_exhausted": ran_dry,
             }
         )
     )
 
-    for consensus in replicas.values():
-        consensus.stop()
-    for comm in comms.values():
-        comm.stop()
-    for rt in schedulers.values():
-        try:
-            rt.stop(timeout=2.0)
-        except RuntimeError:
-            pass
+    teardown(replicas, comms, schedulers)
 
 
 if __name__ == "__main__":

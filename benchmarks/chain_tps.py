@@ -9,134 +9,49 @@ Prints one JSON line: {"metric": "naive_chain_tx_per_sec", ...}
 
 from __future__ import annotations
 
+import itertools
 import json
-import socket
+import os
 import sys
-import threading
 import time
 
-import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")  # protocol-only bench: no device
 
+from benchmarks._harness import start_feeder, start_replicas, teardown
 from consensus_tpu.config import Configuration
-from consensus_tpu.consensus import Consensus
-from consensus_tpu.net import TcpComm
-from consensus_tpu.runtime import RealtimeScheduler
-from consensus_tpu.testing.app import MemWAL, make_request
 from consensus_tpu.testing.app import TestApp as PortsApp
-from consensus_tpu.types import Reconfig
-
-
-class _RealCluster:
-    def __init__(self):
-        self.nodes = {}
-
-    def longest_ledger(self, *, exclude):
-        best = []
-        for node_id, holder in self.nodes.items():
-            if node_id == exclude or not holder.running:
-                continue
-            if len(holder.app.ledger) > len(best):
-                best = holder.app.ledger
-        return list(best)
-
-    def reconfig_of(self, proposal):
-        return Reconfig()
-
-
-class _Holder:
-    def __init__(self, app):
-        self.app = app
-        self.running = True
-
-
-def free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+from consensus_tpu.testing.app import make_request
 
 
 def main() -> None:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
     duration = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
 
-    ports = free_ports(n)
-    addrs = {i + 1: ("127.0.0.1", ports[i]) for i in range(n)}
-    cluster = _RealCluster()
-    replicas, comms, schedulers = {}, {}, {}
-
-    for node_id in addrs:
-        app = PortsApp(node_id, cluster)
-        cluster.nodes[node_id] = _Holder(app)
-        rt = RealtimeScheduler()
-        rt.start(thread_name=f"replica-{node_id}")
-        schedulers[node_id] = rt
-
-        def make_router(nid):
-            def route(sender, payload, is_request):
-                consensus = replicas.get(nid)
-                if consensus is None:
-                    return
-                if is_request:
-                    consensus.handle_request(sender, payload)
-                else:
-                    consensus.handle_message(sender, payload)
-            return route
-
-        comm = TcpComm(node_id, addrs, make_router(node_id), reconnect_backoff=0.05)
-        comm.start()
-        comms[node_id] = comm
-        consensus = Consensus(
-            config=Configuration(
-                self_id=node_id,
-                leader_rotation=False,
-                decisions_per_leader=0,
-                request_batch_max_count=100,
-                request_batch_max_interval=0.005,
-                request_pool_size=2000,
-            ),
-            scheduler=rt,
-            comm=comm,
-            application=app,
-            assembler=app,
-            wal=MemWAL([]),
-            signer=app,
-            verifier=app,
-            request_inspector=app.inspector,
-            synchronizer=app,
+    def make_config(node_id):
+        return Configuration(
+            self_id=node_id,
+            leader_rotation=False,
+            decisions_per_leader=0,
+            request_batch_max_count=100,
+            request_batch_max_interval=0.005,
+            request_pool_size=2000,
         )
-        consensus.start()
-        replicas[node_id] = consensus
+
+    cluster, replicas, comms, schedulers = start_replicas(
+        n, PortsApp, make_config
+    )
 
     leader = replicas[1]
     ledger = cluster.nodes[1].app.ledger
-    stop = threading.Event()
-    submitted = [0]
-
-    def feeder():
-        # Keep the leader's pool topped up; back off when it reports full.
-        i = 0
-        inflight = threading.Semaphore(1500)
-
-        def release(err):
-            inflight.release()
-
-        while not stop.is_set():
-            inflight.acquire()
-            leader.submit_request(make_request("bench", i), release)
-            submitted[0] += 1
-            i += 1
-
-    feeder_thread = threading.Thread(target=feeder, daemon=True)
-    feeder_thread.start()
+    stop, _exhausted = start_feeder(
+        leader,
+        (make_request("bench", i) for i in itertools.count()),
+        inflight=1500,
+    )
 
     # Warmup, then measure.
     time.sleep(2.0)
@@ -165,15 +80,7 @@ def main() -> None:
         )
     )
 
-    for consensus in replicas.values():
-        consensus.stop()
-    for comm in comms.values():
-        comm.stop()
-    for rt in schedulers.values():
-        try:
-            rt.stop(timeout=2.0)
-        except RuntimeError:
-            pass
+    teardown(replicas, comms, schedulers)
 
 
 if __name__ == "__main__":

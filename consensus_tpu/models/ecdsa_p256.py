@@ -129,9 +129,20 @@ def to_kernel_layout(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok):
 class EcdsaP256BatchVerifier:
     """Verify many (message, signature, public key) triples at once."""
 
-    def __init__(self, *, pad_pow2: bool = True, min_device_batch: int = 1) -> None:
+    def __init__(
+        self,
+        *,
+        pad_pow2: bool = True,
+        min_device_batch: int = 1,
+        pad_to: int = 0,
+    ) -> None:
+        """``pad_to`` > 0 pads every device batch to that fixed size (one
+        compiled kernel shape for the whole deployment — no mid-run compiles
+        on underfull batches); batches larger than ``pad_to`` fall back to
+        the pow-2 ladder."""
         self._pad_pow2 = pad_pow2
         self._min_device_batch = min_device_batch
+        self._pad_to = pad_to
 
     def _prepare(self, messages, signatures, public_keys):
         n = len(messages)
@@ -189,7 +200,10 @@ class EcdsaP256BatchVerifier:
         if n < self._min_device_batch:
             return self._verify_host(messages, signatures, public_keys)
         prepped = self._prepare(messages, signatures, public_keys)
-        padded = _next_pow2(n) if self._pad_pow2 else n
+        if self._pad_to >= n:
+            padded = self._pad_to
+        else:
+            padded = _next_pow2(n) if self._pad_pow2 else n
         result = _verify_kernel(*to_kernel_layout(*pad_prepared(prepped, padded)))
         return np.asarray(result)[:n]
 

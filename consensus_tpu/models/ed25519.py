@@ -195,10 +195,16 @@ class Ed25519BatchVerifier:
         *,
         pad_pow2: bool = True,
         min_device_batch: int = 1,
+        pad_to: int = 0,
         device: Optional[object] = None,
     ) -> None:
+        """``pad_to`` > 0 pads every device batch to that fixed size (one
+        compiled kernel shape for the whole deployment — no mid-run compiles
+        on underfull batches); larger batches fall back to the pow-2
+        ladder."""
         self._pad_pow2 = pad_pow2
         self._min_device_batch = min_device_batch
+        self._pad_to = pad_to
         self._device = device
 
     def _prepare(
@@ -267,7 +273,10 @@ class Ed25519BatchVerifier:
             messages, signatures, public_keys
         )
 
-        padded = _next_pow2(n) if self._pad_pow2 else n
+        if self._pad_to >= n:
+            padded = self._pad_to
+        else:
+            padded = _next_pow2(n) if self._pad_pow2 else n
         if padded != n:
             pad = padded - n
             y_r = np.pad(y_r, ((0, pad), (0, 0)))

@@ -9,7 +9,7 @@ backend this is slow-ish but proves the integration the bench measures.
 import numpy as np
 
 from consensus_tpu.models import Ed25519BatchVerifier, Ed25519Signer, Ed25519VerifierMixin
-from consensus_tpu.testing import Cluster, TestApp, make_request
+from consensus_tpu.testing import Cluster, make_request
 from consensus_tpu.testing.crypto_app import CryptoApp
 
 
@@ -23,7 +23,6 @@ class CountingEngine(Ed25519BatchVerifier):
         self.calls += 1
         self.items += len(messages)
         return super().verify_batch(messages, signatures, public_keys)
-
 
 
 
@@ -127,9 +126,10 @@ def test_signed_requests_batch_verified_per_proposal():
         assert cluster.run_until_ledger(i + 1, max_time=300.0)
     cluster.assert_ledgers_consistent()
     total_reqs = sum(
-        len(d.proposal.payload) > 0 for d in cluster.nodes[1].app.ledger
+        int.from_bytes(d.proposal.payload[:4], "big")
+        for d in cluster.nodes[1].app.ledger
     )
-    assert total_reqs >= 2
+    assert total_reqs == 6, f"requests lost: only {total_reqs}/6 ordered"
     assert engine.items >= 6  # request sigs actually drained through batches
 
     # A tampered request never clears ingress.
