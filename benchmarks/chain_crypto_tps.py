@@ -87,13 +87,6 @@ def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
     return signers, make_verifier, engine, clients
 
 
-def _next_pow2(n: int, minimum: int = 8) -> int:
-    size = minimum
-    while size < n:
-        size *= 2
-    return size
-
-
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--family", choices=["ed25519", "p256"], default="ed25519")
@@ -121,6 +114,8 @@ def main() -> None:
     from consensus_tpu.config import Configuration
     from consensus_tpu.metrics import InMemoryProvider, Metrics
     from consensus_tpu.testing.crypto_app import SignedRequestApp
+
+    from consensus_tpu.models.ed25519 import _next_pow2
 
     node_ids = list(range(1, args.n + 1))
     pad_to = _next_pow2(args.batch)

@@ -268,9 +268,13 @@ class Cluster:
     # --- app-level cluster state ------------------------------------------
 
     def longest_ledger(self, *, exclude: int) -> list[Decision]:
+        """Longest ledger among peers REACHABLE from ``exclude`` — state
+        transfer must not tunnel through a network partition."""
         best: list[Decision] = []
         for node_id, node in self.nodes.items():
             if node_id == exclude or not node.running:
+                continue
+            if not self.network.reachable(exclude, node_id):
                 continue
             if len(node.app.ledger) > len(best):
                 best = node.app.ledger

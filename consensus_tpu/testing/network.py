@@ -92,6 +92,18 @@ class SimNetwork:
         """Drop a fraction of messages on the directed link a->b."""
         self._loss[(a, b)] = probability
 
+    def reachable(self, a: int, b: int) -> bool:
+        """Whether a message from ``a`` could currently reach ``b`` —
+        used by the test harness to keep OUT-OF-BAND paths (application
+        state transfer in ``TestApp.sync``) honest about partitions: a
+        partitioned replica must not be able to fetch peer state through a
+        side channel the network would not carry."""
+        if a in self._disconnected or b in self._disconnected:
+            return False
+        if self._loss.get((a, b), 0.0) >= 1.0:
+            return False  # a total-loss link is a cut, not a lossy link
+        return (a, b) not in self._cut_links
+
     def set_delay(self, a: int, b: int, delay: float) -> None:
         self._delay[(a, b)] = delay
 

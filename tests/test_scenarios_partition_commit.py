@@ -1,0 +1,273 @@
+"""Scenario matrix: partitions x commit divergence x heartbeat faults.
+
+Parity model (reference test/basic_test.go):
+TestNodeViewChangeWhileInPartition:63, TestAfterDecisionLeaderInPartition:252,
+TestMultiLeadersPartition:385, TestMultiViewChangeWithNoRequestsTimeout:502,
+TestLeaderCatchingUpAfterViewChange:648,
+TestNodeCommitTheRestPrepareAndCommittedNodeCrashesThenRecovers:2302,
+TestLeaderStopSendHeartbeat:2881, TestTryCommittedSequenceTwice:3015.
+
+Every scenario asserts no-fork safety plus post-heal liveness, and several
+assert no double-delivery (each proposal digest delivered exactly once per
+ledger).
+"""
+
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.wire import Commit, HeartBeat
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+
+def _assert_no_double_delivery(cluster):
+    for node in cluster.nodes.values():
+        digests = [d.proposal.digest() for d in node.app.ledger]
+        assert len(digests) == len(set(digests)), (
+            f"replica {node.node_id} delivered a proposal twice"
+        )
+
+
+def test_view_change_while_node_partitioned():
+    """A node partitioned through a decision rejoins DURING the ensuing
+    view change: the two remaining healthy nodes cannot complete the change
+    alone (quorum 3), so the change must complete exactly when the healed
+    node joins it — and that node must also sync the decision it missed.
+    Parity: basic_test.go:63 (TestNodeViewChangeWhileInPartition)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+
+    # Node 4 misses the first decision entirely.
+    cluster.network.partition([4])
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, node_ids=[1, 2, 3], max_time=300.0)
+
+    # Leader crashes: 2 and 3 start a view change they cannot finish alone.
+    cluster.nodes[1].crash()
+    cluster.scheduler.advance(45.0)  # heartbeat timeout + ViewChange votes
+    assert len(cluster.nodes[4].app.ledger) == 0
+
+    # Heal node 4 mid-view-change: it must join, complete the change, and
+    # sync the decision it missed.
+    cluster.network.heal()
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=900.0), (
+        "view change did not complete after the partitioned node rejoined"
+    )
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)
+
+
+def test_leader_partitioned_after_decision_heals_and_syncs():
+    """The leader is partitioned away AFTER a decision (it stays alive and
+    keeps believing it leads); the rest view-change and keep ordering; on
+    heal the deposed leader must adopt the new view without forking.
+    Parity: basic_test.go:252 (TestAfterDecisionLeaderInPartition)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    cluster.network.partition([1])  # leader alive but alone
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=600.0), (
+        "majority failed to depose the partitioned leader"
+    )
+    # More decisions while the old leader is still isolated.
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, node_ids=[2, 3, 4], max_time=600.0)
+
+    cluster.network.heal()
+    cluster.scheduler.advance(90.0)
+    cluster.submit_to_all(make_request("c", 3))
+    assert cluster.run_until_ledger(4, max_time=600.0), (
+        "healed ex-leader did not catch up"
+    )
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)
+
+
+def test_multi_leader_partition_no_fork():
+    """n=7 split 3/4: NEITHER side reaches quorum (5), so nothing may
+    commit during the split — dueling view-change attempts included — and
+    the healed cluster converges and orders.  Parity: basic_test.go:385
+    (TestMultiLeadersPartition)."""
+    cluster = Cluster(7, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    baseline = len(cluster.nodes[1].app.ledger)
+    cluster.network.partition([1, 2, 3])
+    cluster.submit_to_all(make_request("c", 1))
+    cluster.scheduler.advance(120.0)  # both sides churn through view changes
+    for node in cluster.nodes.values():
+        assert len(node.app.ledger) == baseline, (
+            f"replica {node.node_id} committed during a quorumless split"
+        )
+
+    cluster.network.heal()
+    cluster.scheduler.advance(90.0)
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(baseline + 1, max_time=900.0), (
+        "cluster failed to converge after the dueling-leaders split"
+    )
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)
+
+
+def test_successive_view_changes_without_requests():
+    """Repeated leader failures with NO client traffic: each heartbeat
+    timeout escalates the view; the survivors keep converging on new views
+    and the cluster still orders when traffic arrives.  Parity:
+    basic_test.go:502 (TestMultiViewChangeWithNoRequestsTimeout)."""
+    cluster = Cluster(7, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    # Two successive leaders die with no requests in flight.
+    for victim in (1, 2):
+        cluster.nodes[victim].crash()
+        cluster.scheduler.advance(90.0)  # heartbeat timeout -> view change
+
+    cluster.submit_to_all(make_request("c", 1))
+    live = [i for i, nd in cluster.nodes.items() if nd.running]
+    assert cluster.run_until_ledger(2, node_ids=live, max_time=900.0), (
+        "cluster stalled after quiet successive view changes"
+    )
+    cluster.assert_ledgers_consistent()
+
+
+def test_deposed_leader_catches_up_after_view_change():
+    """A leader isolated mid-proposal misses decisions made in the next
+    view; after healing it must sync the gap and then participate (n=4
+    needs all three survivors plus it for further quorums if one other
+    node is stopped).  Parity: basic_test.go:648
+    (TestLeaderCatchingUpAfterViewChange)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    cluster.network.partition([1])
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=600.0)
+    cluster.network.heal()
+    cluster.scheduler.advance(90.0)
+
+    # Stop node 4: further quorums need the healed ex-leader.
+    cluster.nodes[4].crash()
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, node_ids=[1, 2, 3], max_time=900.0), (
+        "healed ex-leader is not participating in new quorums"
+    )
+    cluster.assert_ledgers_consistent()
+
+
+def test_committed_node_crashes_rest_recommit_and_it_recovers():
+    """One replica reaches the commit quorum and delivers; the others stay
+    PREPARED (their commits were dropped).  The committed node crashes.
+    The survivors must view-change and RE-COMMIT the in-flight proposal
+    (check_in_flight condition A), and the recovered node must not deliver
+    it twice.  Parity: basic_test.go:2302
+    (TestNodeCommitTheRestPrepareAndCommittedNodeCrashesThenRecovers)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+
+    # Drop every Commit not addressed to node 2: only node 2 assembles the
+    # quorum and delivers seq 1.
+    def drop_commits_except_to_2(sender, target, msg):
+        if isinstance(msg, Commit) and target != 2:
+            return None
+        return msg
+
+    cluster.network.mutate_send = drop_commits_except_to_2
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, node_ids=[2], max_time=300.0), (
+        "the designated node never committed"
+    )
+    assert all(
+        len(cluster.nodes[i].app.ledger) == 0 for i in (1, 3, 4)
+    ), "a prepared-only node delivered without a commit quorum"
+
+    # The only committed node crashes; the filter lifts (its damage is done).
+    cluster.network.mutate_send = None
+    cluster.nodes[2].crash()
+
+    # The prepared survivors must re-commit the in-flight proposal via the
+    # view-change path and make progress past it.
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[1, 3, 4], max_time=900.0), (
+        "prepared survivors failed to re-commit the in-flight proposal"
+    )
+
+    # The committed node recovers: same prefix, no double delivery.
+    cluster.nodes[2].restart()
+    cluster.scheduler.advance(120.0)
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, node_ids=[1, 3, 4], max_time=900.0)
+    cluster.scheduler.advance(60.0)
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)
+    assert len(cluster.nodes[2].app.ledger) >= 1
+
+
+def test_leader_heartbeats_muted_gets_deposed():
+    """The leader stays alive and keeps ordering-path messages flowing but
+    its HeartBeat messages are swallowed; with no traffic the followers
+    must depose it on heartbeat timeout.  Parity: basic_test.go:2881
+    (TestLeaderStopSendHeartbeat)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+    view_before = cluster.nodes[2].consensus.controller.curr_view_number
+
+    def mute_leader_heartbeats(sender, target, msg):
+        if sender == 1 and isinstance(msg, HeartBeat):
+            return None
+        return msg
+
+    cluster.network.mutate_send = mute_leader_heartbeats
+    assert cluster.scheduler.run_until(
+        lambda: cluster.nodes[2].consensus.controller.curr_view_number
+        > view_before,
+        max_time=600.0,
+    ), "followers never deposed the heartbeat-muted leader"
+    cluster.network.mutate_send = None
+
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, max_time=600.0)
+    cluster.assert_ledgers_consistent()
+
+
+def test_committed_sequence_not_delivered_twice_through_sync_storm():
+    """A replica that already committed a sequence, then crashes and
+    rejoins through sync + a later view change, must never deliver that
+    sequence twice.  Parity: basic_test.go:3015
+    (TestTryCommittedSequenceTwice)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    for i in range(3):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, max_time=300.0)
+
+    # Crash a follower; order more; restart it (it syncs the gap); then
+    # force a view change so the restored state crosses the VC path too.
+    cluster.nodes[3].crash()
+    cluster.submit_to_all(make_request("c", 3))
+    assert cluster.run_until_ledger(4, node_ids=[1, 2, 4], max_time=600.0)
+    cluster.nodes[3].restart()
+    cluster.scheduler.advance(120.0)
+
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 4))
+    assert cluster.run_until_ledger(5, node_ids=[2, 3, 4], max_time=900.0)
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)

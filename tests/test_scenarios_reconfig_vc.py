@@ -76,7 +76,6 @@ def test_reconfig_submitted_during_view_change():
     # Kill the leader; submit the eviction reconfig IMMEDIATELY, while the
     # view change it provokes is still in flight.
     cluster.nodes[1].crash()
-    cluster.nodes[1].running = False
     cluster.submit_to_all(reconfig_request("rm1", [2, 3, 4, 5]))
     survivors = [2, 3, 4, 5]
     assert cluster.run_until_ledger(2, node_ids=survivors, max_time=600.0)
@@ -108,7 +107,6 @@ def test_restart_between_viewdata_and_newview():
 
     # Crash the leader: 2/3/4 go through a view change to leader 2.
     cluster.nodes[1].crash()
-    cluster.nodes[1].running = False
     # Give the change time to start and node 3's ViewChange/ViewData to be
     # persisted + sent; the NewView reply is dropped on the floor.
     cluster.scheduler.advance(45.0)
