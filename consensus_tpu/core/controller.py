@@ -503,14 +503,11 @@ class Controller:
         )
         self._verification_sequence = new_vseq
 
-        def keep(raw: bytes) -> bool:
-            try:
-                self._verifier.verify_request(raw)
-                return True
-            except Exception:
-                return False
+        def keep_batch(raws: list) -> list:
+            results = self._verifier.verify_requests_batch(raws)
+            return [r is not None for r in results]
 
-        self.pool.prune(keep)
+        self.pool.prune_batch(keep_batch)
 
     # ----------------------------------------------------------------- sync
 

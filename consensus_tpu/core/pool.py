@@ -334,12 +334,20 @@ class RequestPool:
             del self._deleted[key]
 
     def prune(self, keep: Callable[[bytes], bool]) -> None:
-        """Re-validate every pooled request, dropping failures (called when
-        the verification sequence changes).
+        """Per-request :meth:`prune_batch`.  Parity: reference
+        requestpool.go:335-354."""
+        self.prune_batch(lambda raws: [keep(r) for r in raws])
 
-        Parity: reference requestpool.go:335-354.
-        """
-        doomed = [e.info for e in self._fifo.values() if not keep(e.raw)]
+    def prune_batch(self, keep_batch: Callable[[list], "list[bool]"]) -> None:
+        """Like :meth:`prune` but validates the whole pool in ONE call —
+        the controller drains the re-validation burst into the batch
+        verifier instead of the reference's per-request loop (the sig-heavy
+        burst of reference controller.go:733-746)."""
+        entries = list(self._fifo.values())
+        if not entries:
+            return
+        mask = keep_batch([e.raw for e in entries])
+        doomed = [e.info for e, ok in zip(entries, mask) if not ok]
         for info in doomed:
             logger.info("pruning request %s (failed re-validation)", info)
         self.remove_requests(doomed)

@@ -117,17 +117,46 @@ class SignedRequestApp(CryptoApp):
             raise ValueError("bad request signature")
         return RequestInfo(client_id=str(client_idx), request_id=str(seq))
 
-    def verify_proposal(self, proposal) -> Sequence[RequestInfo]:
-        """Batch-verify EVERY request signature in the proposal in one
-        engine call (vs the reference's sequential per-request loop)."""
-        raws = unpack_batch(proposal.payload)
+    def _collect(self, raws, *, tolerate_parse_errors: bool):
+        """(messages, sigs, keys, infos, parsed) for a list of raw requests;
+        ``parsed[i]`` is the batch index of ``raws[i]`` or None if it failed
+        to parse (only when tolerated)."""
         messages, sigs, keys, infos = [], [], [], []
+        parsed = []
         for raw in raws:
-            client_idx, seq, signed, sig = self._split(raw)
+            try:
+                client_idx, seq, signed, sig = self._split(raw)
+            except ValueError:
+                if not tolerate_parse_errors:
+                    raise
+                parsed.append(None)
+                continue
+            parsed.append(len(messages))
             messages.append(_REQ_TAG + signed)
             sigs.append(sig)
             keys.append(self._client_keys[client_idx])
             infos.append(RequestInfo(client_id=str(client_idx), request_id=str(seq)))
+        return messages, sigs, keys, infos, parsed
+
+    def verify_requests_batch(self, raw_requests) -> "list":
+        """ONE engine call for a list of raw requests (the pool's
+        re-validation burst path — controller.maybe_prune_revoked_requests)."""
+        messages, sigs, keys, infos, parsed = self._collect(
+            raw_requests, tolerate_parse_errors=True
+        )
+        if not messages:
+            return [None] * len(raw_requests)
+        ok = self._engine.verify_batch(messages, sigs, keys)
+        return [
+            infos[j] if (j is not None and ok[j]) else None for j in parsed
+        ]
+
+    def verify_proposal(self, proposal) -> Sequence[RequestInfo]:
+        """Batch-verify EVERY request signature in the proposal in one
+        engine call (vs the reference's sequential per-request loop)."""
+        messages, sigs, keys, infos, _ = self._collect(
+            unpack_batch(proposal.payload), tolerate_parse_errors=False
+        )
         if messages:
             ok = self._engine.verify_batch(messages, sigs, keys)
             if not ok.all():

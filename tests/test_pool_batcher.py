@@ -289,3 +289,29 @@ def test_deleted_refresh_keeps_gc_order():
     results = []
     pool.submit(req(2), results.append)  # triggers GC; 2 must be admittable
     assert results == [None], f"expired dedup entry was retained: {results}"
+
+
+def test_prune_batch_validates_pool_in_one_call():
+    """maybe_prune_revoked_requests drains the re-validation burst through
+    verify_requests_batch — ONE batch call for the whole pool, not the
+    reference's per-request loop (reference controller.go:733-746)."""
+    from consensus_tpu.core.pool import PoolOptions, RequestPool
+    from consensus_tpu.runtime.scheduler import SimScheduler
+    from consensus_tpu.testing.app import ByteInspector, make_request
+
+    sched = SimScheduler()
+    pool = RequestPool(sched, ByteInspector(), PoolOptions(pool_size=100))
+    for i in range(10):
+        pool.submit(make_request("c", i))  # admission is synchronous
+    assert len(pool._fifo) == 10
+
+    calls = []
+
+    def keep_batch(raws):
+        calls.append(len(raws))
+        # Drop odd-indexed requests.
+        return [i % 2 == 0 for i in range(len(raws))]
+
+    pool.prune_batch(keep_batch)
+    assert calls == [10], "expected exactly one whole-pool batch call"
+    assert len(pool._fifo) == 5

@@ -147,3 +147,69 @@ def test_signature_big_ids_survive():
     # uint64-range signer ids (ADVICE round 1: '>q' crashed at >= 2**63).
     msg = Commit(view=0, seq=0, digest="", signature=BIG_ID_SIG)
     assert wire.decode_message(wire.encode_message(msg)).signature.id == 2**63 + 5
+
+
+# --- adversarial fuzzing ----------------------------------------------------
+# A Byzantine peer controls every byte on the wire: ANY input must either
+# decode to a well-formed message or raise CodecError — never crash with an
+# unrelated exception, never hang, never return junk that later explodes.
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from consensus_tpu.wire.codec import CodecError, decode_message, encode_message  # noqa: E402
+
+
+@settings(max_examples=300, deadline=None)
+@given(st.binary(min_size=0, max_size=200))
+def test_random_garbage_never_crashes_decoder(data):
+    try:
+        msg = decode_message(data)
+    except CodecError:
+        return
+    # If it decoded, it must re-encode canonically.
+    assert decode_message(encode_message(msg)) == msg
+
+
+@settings(max_examples=300, deadline=None)
+@given(
+    st.sampled_from(range(len(WIRE_MESSAGES))),
+    st.data(),
+)
+def test_bitflipped_encodings_never_crash_decoder(idx, data):
+    raw = bytearray(encode_message(WIRE_MESSAGES[idx]))
+    n_flips = data.draw(st.integers(1, 8))
+    for _ in range(n_flips):
+        pos = data.draw(st.integers(0, len(raw) - 1))
+        raw[pos] ^= 1 << data.draw(st.integers(0, 7))
+    try:
+        msg = decode_message(bytes(raw))
+    except CodecError:
+        return
+    assert decode_message(encode_message(msg)) == msg
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    view=st.integers(0, 2**64 - 1),
+    seq=st.integers(0, 2**64 - 1),
+    payload=st.binary(max_size=64),
+    header=st.binary(max_size=16),
+    metadata=st.binary(max_size=32),
+    vseq=st.integers(0, 2**32 - 1),
+    sig_id=st.integers(1, 2**64 - 1),
+    sig_value=st.binary(max_size=80),
+    aux=st.binary(max_size=40),
+)
+def test_generated_preprepare_roundtrip(
+    view, seq, payload, header, metadata, vseq, sig_id, sig_value, aux
+):
+    msg = PrePrepare(
+        view=view,
+        seq=seq,
+        proposal=Proposal(
+            payload=payload, header=header, metadata=metadata,
+            verification_sequence=vseq,
+        ),
+        prev_commit_signatures=(Signature(id=sig_id, value=sig_value, msg=aux),),
+    )
+    assert decode_message(encode_message(msg)) == msg
