@@ -504,7 +504,22 @@ class Controller:
         self._verification_sequence = new_vseq
 
         def keep_batch(raws: list) -> list:
-            results = self._verifier.verify_requests_batch(raws)
+            try:
+                results = self._verifier.verify_requests_batch(raws)
+            except Exception:
+                # Infrastructure failure (e.g. the verify device dropped
+                # out) is not "every request is invalid": keep the pool and
+                # let per-proposal verification catch stale requests.
+                logger.exception(
+                    "%d: batch re-validation failed; deferring prune", self.id
+                )
+                return [True] * len(raws)
+            if len(results) != len(raws):
+                logger.error(
+                    "%d: verifier returned %d results for %d requests; "
+                    "deferring prune", self.id, len(results), len(raws),
+                )
+                return [True] * len(raws)
             return [r is not None for r in results]
 
         self.pool.prune_batch(keep_batch)

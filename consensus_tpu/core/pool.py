@@ -347,7 +347,9 @@ class RequestPool:
         if not entries:
             return
         mask = keep_batch([e.raw for e in entries])
-        doomed = [e.info for e, ok in zip(entries, mask) if not ok]
+        doomed = [
+            e.info for e, ok in zip(entries, mask, strict=True) if not ok
+        ]
         for info in doomed:
             logger.info("pruning request %s (failed re-validation)", info)
         self.remove_requests(doomed)

@@ -21,6 +21,15 @@ convolution) followed by *parallel* carry-save passes (split with
 borrows propagate like arithmetic shifts).  There are no sequential carry
 chains on the hot path.
 
+Why pure XLA and no hand-written Pallas kernel: the verify graph is a
+``lax.scan`` of elementwise/broadcast limb arithmetic, which XLA already
+fuses into large VPU kernels; a per-field-op ``pallas_call`` only adds
+launch overhead (a round-2 prototype confirmed parity but no win and was
+removed).  The remaining headroom is a kernel holding the whole 64-step
+scan carry + per-batch table in VMEM — that design needs on-device
+iteration to validate Pallas/Mosaic lowering, and is deferred until TPU
+access is available in-round (see COVERAGE.md).
+
 Normalization contract: public ops take and return *weakly reduced*
 elements — |limb| <= 340 with value within (-2^250, 2^255 + 2^13), exact
 mod p.  ``freeze`` (rare path: comparisons/parity) converts to int32 and

@@ -414,24 +414,3 @@ class TestSharding:
         out = jax.jit(fn)(*args)
         assert out.shape == (8,) and bool(out[0]) and not bool(out[1])
         g.dryrun_multichip(8)
-
-
-class TestPallas:
-    def test_pallas_mul_matches_jnp_path(self):
-        # The opt-in Pallas kernel (interpret mode on CPU) must agree with
-        # the default XLA path bit for bit.
-        from consensus_tpu.ops import pallas_field
-
-        rng = random.Random(31)
-        vals_a = [rng.randrange(fe.P) for _ in range(128)]
-        vals_b = [rng.randrange(fe.P) for _ in range(128)]
-        a, b = limbs_of(vals_a), limbs_of(vals_b)
-        out = pallas_field.mul(a, b, interpret=True)
-        assert ints_of(out) == ints_of(fe.mul(a, b))
-
-    def test_pallas_rejects_unaligned_batch(self):
-        from consensus_tpu.ops import pallas_field
-
-        a = limbs_of([1] * 100)
-        with pytest.raises(ValueError):
-            pallas_field.mul(a, a, interpret=True)

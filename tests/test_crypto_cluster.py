@@ -138,3 +138,34 @@ def test_signed_requests_batch_verified_per_proposal():
     bad[-1] ^= 0xFF
     with pytest.raises(ValueError):
         app.verify_request(bytes(bad))
+
+
+def test_verify_requests_batch_remaps_around_unparseable_entries():
+    """The batch request-verify path must return results aligned with the
+    INPUT list even when unparseable entries are interleaved (the pruning
+    burst sees arbitrary pool contents)."""
+    from consensus_tpu.models import Ed25519Signer
+    from consensus_tpu.testing import ClientKeyring, Cluster, SignedRequestApp
+
+    cluster = Cluster(4)
+    engine = CountingEngine(min_device_batch=10**9)
+    signer = Ed25519Signer(1)
+    clients = ClientKeyring([Ed25519Signer(100 + i) for i in range(2)])
+    keys = {1: signer.public_bytes}
+    app = SignedRequestApp(
+        1, cluster, signer, _SigVerifier(keys, engine=engine),
+        client_keys=clients.public_keys, engine=engine,
+    )
+
+    good0 = clients.make_request(0, 7)
+    good1 = clients.make_request(1, 8)
+    bad_sig = bytearray(clients.make_request(0, 9))
+    bad_sig[-1] ^= 0xFF
+    raws = [b"short", good0, b"\x00" * 200, bytes(bad_sig), good1]
+    out = app.verify_requests_batch(raws)
+    assert out[0] is None            # too short to parse
+    assert out[1] is not None and out[1].request_id == "7"
+    assert out[2] is None            # unknown client index
+    assert out[3] is None            # parseable but invalid signature
+    assert out[4] is not None and out[4].request_id == "8"
+    assert engine.calls == 1, "one engine batch for the whole list"
