@@ -95,6 +95,14 @@ def main() -> None:
     ap.add_argument("--verify", choices=["device", "host"], default="device")
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument(
+        "--rotate",
+        type=int,
+        default=0,
+        metavar="DECISIONS",
+        help="leader rotation every N decisions (BASELINE config 4: "
+        "n=10, --rotate 100); 0 = rotation off",
+    )
     ap.add_argument("--presign", type=int, default=100000)
     ap.add_argument(
         "--platform",
@@ -163,8 +171,8 @@ def main() -> None:
     def make_config(node_id):
         return Configuration(
             self_id=node_id,
-            leader_rotation=False,
-            decisions_per_leader=0,
+            leader_rotation=args.rotate > 0,
+            decisions_per_leader=args.rotate,
             request_batch_max_count=args.batch,
             request_batch_max_interval=0.02,
             request_pool_size=max(2000, 3 * args.batch),
@@ -176,6 +184,9 @@ def main() -> None:
 
     leader = replicas[1]
     ledger = cluster.nodes[1].app.ledger
+    # Under rotation the leader moves between proposals; submitting to a
+    # fixed replica still works (stage-1 forwarding), which is exactly what
+    # the reference's clients do.
     stop, exhausted = start_feeder(
         leader, presigned, inflight=max(1500, 2 * args.batch)
     )
@@ -220,6 +231,7 @@ def main() -> None:
                 "n": args.n,
                 "f": (args.n - 1) // 3,
                 "batch": args.batch,
+                "rotate_every": args.rotate,
                 "blocks_per_sec": round((end_blocks - start_blocks) / elapsed, 1),
                 "p50_commit_latency_ms": pct(0.50),
                 "p90_commit_latency_ms": pct(0.90),

@@ -1,0 +1,208 @@
+"""Scenario matrix: restarts x leader rotation x blacklist churn.
+
+Parity model (reference test/basic_test.go):
+TestRestartFollowers:152, TestLeaderProposeAfterRestartWithoutSync:1328,
+TestRotateAndViewChange:1600, TestMigrateToBlacklistAndBackAgain:1716,
+TestNodeInFlightFails:1834, TestBlacklistMultipleViewChanges:2091,
+TestNodeInFlightThenViewChange:2215, TestFollowerStateTransfer:1051.
+
+Each scenario asserts no-fork safety and liveness after the churn settles.
+"""
+
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.wire import Prepare
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+
+def test_restart_followers_one_by_one():
+    """Each follower restarts in turn between decisions; every restarted
+    node recovers its position from the WAL and keeps delivering.  Parity:
+    basic_test.go:152 (TestRestartFollowers)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    for i, follower in enumerate((2, 3, 4)):
+        cluster.nodes[follower].restart()
+        cluster.scheduler.advance(30.0)
+        cluster.submit_to_all(make_request("c", i + 1))
+        assert cluster.run_until_ledger(i + 2, max_time=600.0), (
+            f"ordering stalled after restarting follower {follower}"
+        )
+    cluster.assert_ledgers_consistent()
+
+
+def test_leader_proposes_after_restart_without_sync():
+    """The leader restarts between decisions with nothing to catch up on:
+    it must resume proposing straight from its WAL/checkpoint state (no
+    sync detour required — nobody moved past it).  Parity:
+    basic_test.go:1328 (TestLeaderProposeAfterRestartWithoutSync)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    cluster.nodes[1].restart()
+    cluster.scheduler.advance(30.0)
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, max_time=600.0), (
+        "restarted leader did not resume proposing"
+    )
+    cluster.assert_ledgers_consistent()
+
+
+def test_rotate_and_view_change():
+    """Leader rotation every decision + a crashed replica: rotation keeps
+    handing leadership to the dead node, each time forcing a view change,
+    and the cluster still makes steady progress; the node catches up after
+    restart.  Parity: basic_test.go:1600 (TestRotateAndViewChange)."""
+    cluster = Cluster(
+        4, config_tweaks=dict(FAST, decisions_per_leader=1), leader_rotation=True
+    )
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    cluster.nodes[4].crash()
+    survivors = [1, 2, 3]
+    for i in range(1, 5):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(
+            i + 1, node_ids=survivors, max_time=900.0
+        ), f"rotation+view-change stalled at block {i}"
+
+    cluster.nodes[4].restart()
+    cluster.scheduler.advance(120.0)
+    cluster.submit_to_all(make_request("c", 9))
+    assert cluster.run_until_ledger(6, node_ids=survivors, max_time=900.0)
+    cluster.scheduler.advance(120.0)
+    assert len(cluster.nodes[4].app.ledger) >= 5, "restarted node did not catch up"
+    cluster.assert_ledgers_consistent()
+
+
+def test_blacklist_churn_across_multiple_view_changes():
+    """n=7 rotation with one crashed replica across MANY rotation cycles:
+    repeated view changes accrue/maintain the blacklist without wedging
+    rotation or forking.  Parity: basic_test.go:2091
+    (TestBlacklistMultipleViewChanges), compressed."""
+    cluster = Cluster(
+        7, config_tweaks=dict(FAST, decisions_per_leader=1), leader_rotation=True
+    )
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=600.0)
+
+    cluster.nodes[3].crash()
+    survivors = [1, 2, 4, 5, 6, 7]
+    # Two full rotation cycles with the dead replica in the schedule.
+    for i in range(1, 15):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(
+            i + 1, node_ids=survivors, max_time=900.0
+        ), f"blacklist churn stalled at block {i}"
+    cluster.assert_ledgers_consistent()
+
+
+def test_in_flight_proposal_when_leader_fails_before_any_commit():
+    """The leader gets a proposal prepared on the followers but dies before
+    ANY commit lands: the view change must either re-commit it (if f+1
+    prepared) or drop it — consistently — and the next leader orders new
+    work.  Parity: basic_test.go:1834 (TestNodeInFlightFails)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    # Block every Commit: the next proposal can prepare but never commit.
+    from consensus_tpu.wire import Commit
+
+    def drop_all_commits(sender, target, msg):
+        if isinstance(msg, Commit):
+            return None
+        return msg
+
+    cluster.network.mutate_send = drop_all_commits
+    cluster.submit_to_all(make_request("c", 1))
+    cluster.scheduler.advance(6.0)  # enough for pre-prepare + prepares
+    assert all(len(n.app.ledger) == 1 for n in cluster.nodes.values())
+
+    cluster.nodes[1].crash()
+    cluster.network.mutate_send = None
+
+    # Survivors: the prepared in-flight proposal resolves through the view
+    # change, then ordering continues.
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=900.0), (
+        "in-flight proposal did not resolve after leader failure"
+    )
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, node_ids=[2, 3, 4], max_time=900.0)
+    cluster.assert_ledgers_consistent()
+
+
+def test_in_flight_partial_prepare_then_view_change():
+    """Only SOME followers saw the in-flight proposal's prepares when the
+    leader dies (prepares to one follower dropped): check_in_flight must
+    still resolve consistently across the survivors.  Parity:
+    basic_test.go:2215 (TestNodeInFlightThenViewChange)."""
+    from consensus_tpu.wire import Commit
+
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    def drop_commits_and_prepares_to_4(sender, target, msg):
+        if isinstance(msg, Commit):
+            return None
+        if target == 4 and isinstance(msg, Prepare):
+            return None
+        return msg
+
+    cluster.network.mutate_send = drop_commits_and_prepares_to_4
+    cluster.submit_to_all(make_request("c", 1))
+    cluster.scheduler.advance(6.0)
+
+    cluster.nodes[1].crash()
+    cluster.network.mutate_send = None
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=900.0), (
+        "partially-prepared in-flight proposal did not resolve"
+    )
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, node_ids=[2, 3, 4], max_time=900.0)
+    cluster.assert_ledgers_consistent()
+
+
+def test_follower_state_transfer_from_far_behind():
+    """A follower down through MANY decisions rejoins and state-transfers
+    the whole gap, then participates in new quorums.  Parity:
+    basic_test.go:1051 (TestFollowerStateTransfer)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    cluster.nodes[4].crash()
+    for i in range(1, 8):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, node_ids=[1, 2, 3], max_time=600.0)
+
+    cluster.nodes[4].restart()
+    cluster.scheduler.advance(120.0)
+    # Stop node 3: further quorums need the freshly-synced node 4.
+    cluster.nodes[3].crash()
+    cluster.submit_to_all(make_request("c", 99))
+    assert cluster.run_until_ledger(9, node_ids=[1, 2, 4], max_time=900.0), (
+        "state-transferred follower is not participating in quorums"
+    )
+    assert len(cluster.nodes[4].app.ledger) >= 9
+    cluster.assert_ledgers_consistent()
