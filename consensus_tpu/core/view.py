@@ -198,10 +198,24 @@ class View:
             # from clock epoch.
             self._begin_pre_prepare = self._sched.now()
         self.metrics.phase.set(int(self.phase))
+        # The recovery rebroadcast goes out WITHOUT the assist flag: peers
+        # that already moved past this sequence reply to a non-assist
+        # message with their own prev-seq assist copies (that reply is how
+        # a commit-starved replica closes its gap), but deliberately ignore
+        # assist-marked ones to avoid reply loops.  The stored *_sent copies
+        # keep assist=True for their other job, straggler retransmission
+        # help.  Parity: reference view.go:285-288 ("broadcast here serves
+        # also recovery") vs the assist copies of view.go:417,512.
+        import dataclasses
+
         if self.phase == Phase.PROPOSED and self._curr_prepare_sent is not None:
-            self._comm.broadcast(self._curr_prepare_sent)
+            self._comm.broadcast(
+                dataclasses.replace(self._curr_prepare_sent, assist=False)
+            )
         elif self.phase == Phase.PREPARED and self._curr_commit_sent is not None:
-            self._comm.broadcast(self._curr_commit_sent)
+            self._comm.broadcast(
+                dataclasses.replace(self._curr_commit_sent, assist=False)
+            )
 
     def propose(self, proposal: Proposal) -> None:
         """Leader entry point: wrap ``proposal`` in a PrePrepare carrying the

@@ -10,7 +10,7 @@ Each scenario asserts no-fork safety and liveness after the churn settles.
 """
 
 from consensus_tpu.testing import Cluster, make_request
-from consensus_tpu.wire import Prepare
+from consensus_tpu.wire import Commit, HeartBeat, Prepare, PrePrepare
 
 FAST = {
     "request_forward_timeout": 1.0,
@@ -124,7 +124,6 @@ def test_in_flight_proposal_when_leader_fails_before_any_commit():
     assert cluster.run_until_ledger(1, max_time=300.0)
 
     # Block every Commit: the next proposal can prepare but never commit.
-    from consensus_tpu.wire import Commit
 
     def drop_all_commits(sender, target, msg):
         if isinstance(msg, Commit):
@@ -154,7 +153,6 @@ def test_in_flight_partial_prepare_then_view_change():
     leader dies (prepares to one follower dropped): check_in_flight must
     still resolve consistently across the survivors.  Parity:
     basic_test.go:2215 (TestNodeInFlightThenViewChange)."""
-    from consensus_tpu.wire import Commit
 
     cluster = Cluster(4, config_tweaks=FAST)
     cluster.start()
@@ -205,4 +203,142 @@ def test_follower_state_transfer_from_far_behind():
         "state-transferred follower is not participating in quorums"
     )
     assert len(cluster.nodes[4].app.ledger) >= 9
+    cluster.assert_ledgers_consistent()
+
+
+def test_leader_excludes_one_follower():
+    """The leader's link to ONE follower is cut (pairwise): the excluded
+    follower must detect it is being left behind (heartbeat gap) and catch
+    up through its peers while the cluster keeps ordering.  Parity:
+    basic_test.go:891 (TestLeaderExclusion)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    cluster.network.disconnect_pair(1, 4)
+    for i in range(1, 6):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(
+            i + 1, node_ids=[1, 2, 3], max_time=600.0
+        )
+    # Node 4 hears prepares/commits from 2 and 3 (and heartbeat gaps) and
+    # must close the distance without the leader's direct traffic.
+    assert cluster.scheduler.run_until(
+        lambda: len(cluster.nodes[4].app.ledger) >= 6, max_time=900.0
+    ), "excluded follower never caught up"
+    cluster.assert_ledgers_consistent()
+
+
+def test_leader_catches_up_without_full_sync():
+    """The leader proposes seq 2 but every Commit addressed to IT is lost;
+    the followers deliver.  After a restart the leader restores its
+    prepared state from the WAL and closes the gap.  Parity:
+    basic_test.go:1258 (TestLeaderCatchUpWithoutSync)."""
+
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    def drop_commits_to_leader(sender, target, msg):
+        if target == 1 and isinstance(msg, Commit):
+            return None
+        return msg
+
+    cluster.network.mutate_send = drop_commits_to_leader
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=600.0), (
+        "followers failed to deliver while the leader was commit-starved"
+    )
+    assert len(cluster.nodes[1].app.ledger) == 1
+
+    cluster.network.mutate_send = None
+    cluster.nodes[1].restart()
+    assert cluster.scheduler.run_until(
+        lambda: len(cluster.nodes[1].app.ledger) >= 2, max_time=900.0
+    ), "restarted leader never recovered the commit-starved decision"
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, max_time=900.0)
+    cluster.assert_ledgers_consistent()
+
+
+def test_behind_follower_heartbeat_gap_triggers_sync():
+    """A follower whose ordering traffic is filtered (but that still sees
+    heartbeats) must notice the leader's sequence running ahead and sync —
+    without a restart.  Parity: basic_test.go:925/971
+    (TestCatchingUpWithSyncAssisted / Autonomous)."""
+
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    def starve_4(sender, target, msg):
+        if target == 4 and isinstance(msg, (PrePrepare, Prepare, Commit)):
+            return None
+        return msg
+
+    cluster.network.mutate_send = starve_4
+    for i in range(1, 4):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(
+            i + 1, node_ids=[1, 2, 3], max_time=600.0
+        )
+    assert len(cluster.nodes[4].app.ledger) == 1
+
+    cluster.network.mutate_send = None
+    assert cluster.scheduler.run_until(
+        lambda: len(cluster.nodes[4].app.ledger) >= 4, max_time=900.0
+    ), "starved follower never synced from the heartbeat gap"
+    cluster.assert_ledgers_consistent()
+
+
+def test_restart_after_view_change_lands_in_current_view():
+    """A node that slept through a view change restarts with pre-change
+    state; its sync returns decisions stamped with the OLD view (nothing
+    was ordered in the new one yet), so the state-transfer round must carry
+    it into the CURRENT view before it can participate.  Parity:
+    basic_test.go:2742 (TestFetchStateWhenSyncReturnsPrevView)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    # Node 4 sleeps through everything from here.
+    cluster.nodes[4].crash()
+
+    # Depose leader 1 WITHOUT killing it (mute its heartbeats): 1, 2 and 3
+    # can then complete the view change — and no decision lands in the new
+    # view, so every synced decision stays stamped with view 0.
+    view_before = cluster.nodes[2].consensus.controller.curr_view_number
+
+    def mute_leader_heartbeats(sender, target, msg):
+        if sender == 1 and isinstance(msg, HeartBeat):
+            return None
+        return msg
+
+    cluster.network.mutate_send = mute_leader_heartbeats
+    assert cluster.scheduler.run_until(
+        lambda: cluster.nodes[2].consensus.controller.curr_view_number
+        > view_before,
+        max_time=600.0,
+    ), "view change away from the muted leader never completed"
+    cluster.network.mutate_send = None
+
+    # Restart node 4: its sync returns only view-0 decisions; the state
+    # transfer must still land it in the CURRENT view.
+    cluster.nodes[4].restart()
+    cluster.scheduler.advance(120.0)
+
+    # Crash node 1: the quorum for new work is now {2, 3, 4}, so progress
+    # proves node 4 made it into the post-change view.
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.scheduler.run_until(
+        lambda: all(
+            len(cluster.nodes[i].app.ledger) >= 2 for i in (2, 3, 4)
+        ),
+        max_time=900.0,
+    ), "restarted node never joined the post-view-change quorum"
     cluster.assert_ledgers_consistent()

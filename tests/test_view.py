@@ -425,6 +425,19 @@ def test_rotation_off_requires_empty_blacklist():
     assert h.view.phase == Phase.ABORT
 
 
+def test_restored_proposed_view_rebroadcasts_prepare_without_assist():
+    h = Harness()
+    proposal = h.make_proposal()
+    h.view.phase = Phase.PROPOSED
+    h.view.in_flight_proposal = proposal
+    prepare = Prepare(view=0, seq=0, digest=proposal.digest(), assist=True)
+    h.view._curr_prepare_sent = prepare
+    h.view.start()
+    sent = h.comm.broadcasts[-1]
+    assert sent == Prepare(view=0, seq=0, digest=proposal.digest())
+    assert not sent.assist
+
+
 def test_restored_prepared_view_rebroadcasts_commit():
     h = Harness()
     proposal = h.make_proposal()
@@ -437,4 +450,13 @@ def test_restored_prepared_view_rebroadcasts_commit():
     )
     h.view._curr_commit_sent = commit
     h.view.start()
-    assert h.comm.broadcasts[-1] == commit
+    # The recovery rebroadcast must NOT carry the assist flag: peers ahead
+    # of us ignore assist messages (loop prevention), and their prev-seq
+    # assist replies to this message are how a commit-starved replica
+    # recovers (reference view.go:285-288).
+    sent = h.comm.broadcasts[-1]
+    assert sent == Commit(
+        view=commit.view, seq=commit.seq, digest=commit.digest,
+        signature=commit.signature,
+    )
+    assert not sent.assist
