@@ -23,6 +23,7 @@ Architectural deviations (deliberate, TPU-first):
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from enum import IntEnum
 from typing import Callable, Optional, Protocol, Sequence
@@ -206,8 +207,6 @@ class View:
         # keep assist=True for their other job, straggler retransmission
         # help.  Parity: reference view.go:285-288 ("broadcast here serves
         # also recovery") vs the assist copies of view.go:417,512.
-        import dataclasses
-
         if self.phase == Phase.PROPOSED and self._curr_prepare_sent is not None:
             self._comm.broadcast(
                 dataclasses.replace(self._curr_prepare_sent, assist=False)

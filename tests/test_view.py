@@ -460,3 +460,101 @@ def test_restored_prepared_view_rebroadcasts_commit():
         signature=commit.signature,
     )
     assert not sent.assist
+
+
+class TestAdversarialInputs:
+    """Bad pre-prepare / prepare / commit matrices.  Parity: reference
+    view_test.go:148 (TestBadPrePrepare), :362 (TestBadPrepare),
+    :466 (TestBadCommit), :1138 (TestTwoPrePreparesInARow)."""
+
+    def test_empty_proposal_pre_prepare_ignored(self):
+        h = Harness()
+        pp = PrePrepare(view=0, seq=0, proposal=Proposal())
+        h.view.handle_message(1, pp)
+        # Empty proposal has no metadata: treated as a bad proposal.
+        assert h.view.phase in (Phase.ABORT, Phase.COMMITTED)
+        assert h.decider.decisions == []
+
+    def test_second_pre_prepare_same_seq_ignored(self):
+        h = Harness()
+        proposal = h.make_proposal()
+        h.view.handle_message(1, h.pre_prepare(proposal))
+        assert h.view.phase == Phase.PROPOSED
+        saved_before = len(h.state.saved)
+        # A second, different pre-prepare for the same sequence must not
+        # displace the accepted one (or safety breaks).
+        other = Proposal(payload=b"other", metadata=proposal.metadata)
+        h.view.handle_message(1, h.pre_prepare(other))
+        assert h.view.in_flight_proposal == proposal
+        assert len(h.state.saved) == saved_before
+
+    def test_prepare_from_future_view_from_follower_ignored(self):
+        h = Harness()
+        proposal = h.make_proposal()
+        h.view.handle_message(1, h.pre_prepare(proposal))
+        h.view.handle_message(3, Prepare(view=7, seq=0, digest=proposal.digest()))
+        assert h.view.phase == Phase.PROPOSED  # nothing counted, no abort
+
+    def test_prepare_from_future_view_from_leader_aborts_and_complains(self):
+        h = Harness()
+        proposal = h.make_proposal()
+        h.view.handle_message(1, h.pre_prepare(proposal))
+        h.view.handle_message(1, Prepare(view=7, seq=0, digest=proposal.digest()))
+        assert h.view.phase == Phase.ABORT
+        assert h.fd.complaints
+        assert h.sync.calls >= 1
+
+    def test_duplicate_prepares_from_same_sender_count_once(self):
+        h = Harness()
+        proposal = h.make_proposal()
+        h.view.handle_message(1, h.pre_prepare(proposal))
+        digest = proposal.digest()
+        h.view.handle_message(3, Prepare(view=0, seq=0, digest=digest))
+        h.view.handle_message(3, Prepare(view=0, seq=0, digest=digest))
+        assert h.view.phase == Phase.PROPOSED  # still needs one more voter
+
+    def test_commit_with_wrong_digest_not_counted(self):
+        h = Harness()
+        proposal = h.make_proposal()
+        walk_to_prepared(h, proposal)
+        h.view.handle_message(
+            3, Commit(view=0, seq=0, digest="beef" * 16, signature=sig_for(3))
+        )
+        h.view.handle_message(
+            4, Commit(view=0, seq=0, digest="beef" * 16, signature=sig_for(4))
+        )
+        assert h.decider.decisions == []
+
+    def test_commit_from_node_outside_membership_dropped_at_ingress(self):
+        """Membership filtering happens at the facade ingress (parity:
+        reference consensus.go:292-300) — the view trusts pre-filtered
+        senders, and unknown signers additionally fail real signature
+        verification at the key registry."""
+        from consensus_tpu.testing import Cluster, make_request
+        from consensus_tpu.wire import Commit as WireCommit
+
+        cluster = Cluster(4)
+        cluster.start()
+        cluster.submit_to_all(make_request("c", 0))
+        assert cluster.run_until_ledger(1)
+        target = cluster.nodes[2].consensus
+        before = len(cluster.nodes[2].app.ledger)
+        # A commit claiming to be from node 9 (not a member) must be
+        # dropped before it reaches any component.
+        target.handle_message(
+            9, WireCommit(view=0, seq=1, digest="aa" * 32, signature=sig_for(9))
+        )
+        cluster.scheduler.advance(5.0)
+        assert len(cluster.nodes[2].app.ledger) == before
+
+    def test_future_seq_commit_buffered_not_applied(self):
+        h = Harness()
+        proposal = h.make_proposal()
+        h.view.handle_message(1, h.pre_prepare(proposal))
+        # Commit for seq 1 while we are at seq 0: pipelining buffers it but
+        # must not decide anything.
+        h.view.handle_message(
+            3, Commit(view=0, seq=1, digest="aa" * 32, signature=sig_for(3))
+        )
+        assert h.decider.decisions == []
+        assert h.view.proposal_sequence == 0

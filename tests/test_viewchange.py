@@ -436,3 +436,146 @@ def test_restore_resend_fires_until_quorum():
     vc_msgs = [m for m in comm.broadcasts if isinstance(m, VC)]
     assert len(vc_msgs) >= 2, "vote must be re-broadcast on the resend timer"
     vc.stop()
+
+
+class TestCheckInFlightReferenceTable:
+    """The reference's full CheckInFlight decision table, ported case by
+    case.  Parity: reference viewchanger_test.go:1667-1745
+    (TestCheckInFlightNoProposal) and :1745-1905 (TestCheckInFlightWithProposal).
+    n=4, f=1, quorum=3; last decision at seq 1, expected in-flight seq 2."""
+
+    def _expected(self):
+        return proposal_at(2, payload=b"expected")
+
+    def _old(self):
+        # "Old in flight" = the last decision itself (seq 1 != expected 2).
+        return proposal_at(1)
+
+    def run_case(self, msgs):
+        return check_in_flight(msgs, F, QUORUM)
+
+    # --- no-proposal outcomes (all must return ok) ----------------------
+
+    def test_all_without_in_flight(self):
+        ok, no, prop = self.run_case([vd(last_seq=1) for _ in range(4)])
+        assert (ok, no, prop) == (True, True, None)
+
+    def test_all_with_old_in_flight(self):
+        msgs = [vd(last_seq=1, in_flight=self._old()) for _ in range(4)]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, True, None)
+
+    def test_quorum_without_one_with_unprepared_expected(self):
+        msgs = [vd(last_seq=1) for _ in range(4)]
+        msgs[0] = vd(last_seq=1, in_flight=self._expected())
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, True, None)
+
+    def test_all_old_one_with_unprepared_expected(self):
+        msgs = [vd(last_seq=1, in_flight=self._old()) for _ in range(4)]
+        msgs[0] = vd(last_seq=1, in_flight=self._expected())
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, True, None)
+
+    def test_mix_of_none_old_and_unprepared_expected(self):
+        msgs = [
+            vd(last_seq=1, in_flight=self._old()),
+            vd(last_seq=1, in_flight=self._old()),
+            vd(last_seq=1, in_flight=self._expected()),
+            vd(last_seq=1),
+        ]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, True, None)
+
+    def test_two_unprepared_expected_still_condition_b(self):
+        msgs = [
+            vd(last_seq=1, in_flight=self._old()),
+            vd(last_seq=1),
+            vd(last_seq=1, in_flight=self._expected()),
+            vd(last_seq=1, in_flight=self._expected()),
+        ]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, True, None)
+
+    # --- with-proposal outcomes -----------------------------------------
+
+    def test_all_prepared_expected(self):
+        exp = self._expected()
+        msgs = [vd(last_seq=1, in_flight=exp, prepared=True) for _ in range(4)]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, False, exp)
+
+    def test_quorum_prepared_expected_one_without(self):
+        exp = self._expected()
+        msgs = [vd(last_seq=1, in_flight=exp, prepared=True) for _ in range(4)]
+        msgs[0] = vd(last_seq=1)
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, False, exp)
+
+    def test_quorum_prepared_expected_one_with_old(self):
+        exp = self._expected()
+        msgs = [vd(last_seq=1, in_flight=exp, prepared=True) for _ in range(4)]
+        msgs[0] = vd(last_seq=1, in_flight=self._old(), prepared=True)
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, False, exp)
+
+    def test_quorum_prepared_expected_one_with_different(self):
+        exp = self._expected()
+        different = proposal_at(2, payload=b"different")
+        msgs = [vd(last_seq=1, in_flight=exp, prepared=True) for _ in range(4)]
+        msgs[0] = vd(last_seq=1, in_flight=different, prepared=True)
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, False, exp)
+
+    def test_one_prepared_expected_carried_by_quorum_one_different(self):
+        exp = self._expected()
+        different = proposal_at(2, payload=b"different-header")
+        msgs = [
+            vd(last_seq=1, in_flight=different),
+            vd(last_seq=1, in_flight=exp),
+            vd(last_seq=1, in_flight=exp),
+            vd(last_seq=1, in_flight=exp, prepared=True),
+        ]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, False, exp)
+
+    def test_all_expected_but_none_prepared(self):
+        exp = self._expected()
+        msgs = [vd(last_seq=1, in_flight=exp, prepared=False) for _ in range(4)]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, True, None)
+
+    def test_split_prepared_no_quorum_on_any(self):
+        exp = self._expected()
+        different = proposal_at(2, payload=b"split")
+        msgs = [
+            vd(last_seq=1, in_flight=exp, prepared=True),
+            vd(last_seq=1, in_flight=exp, prepared=True),
+            vd(last_seq=1, in_flight=different, prepared=True),
+            vd(last_seq=1, in_flight=different, prepared=True),
+        ]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (False, False, None)
+
+    def test_single_prepared_witness_rest_empty_condition_b(self):
+        msgs = [vd(last_seq=1) for _ in range(4)]
+        msgs[2] = vd(last_seq=1, in_flight=self._expected(), prepared=True)
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (True, True, None)
+
+    def test_three_way_split_not_enough_for_anything(self):
+        exp = self._expected()
+        other_view = proposal_at(2, view=1, payload=b"expected")
+        other_vseq = Proposal(
+            payload=b"expected",
+            metadata=exp.metadata,
+            verification_sequence=5,
+        )
+        msgs = [
+            vd(last_seq=1),
+            vd(last_seq=1, in_flight=other_vseq, prepared=True),
+            vd(last_seq=1, in_flight=exp, prepared=True),
+            vd(last_seq=1, in_flight=other_view, prepared=True),
+        ]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (False, False, None)
