@@ -579,3 +579,103 @@ class TestCheckInFlightReferenceTable:
         ]
         ok, no, prop = self.run_case(msgs)
         assert (ok, no, prop) == (False, False, None)
+
+
+class TestAdversarialViewChangeInputs:
+    """Bad SignedViewData / NewView matrices driven through the public
+    process paths.  Parity: reference viewchanger_test.go (bad-ViewData and
+    validateNewViewMsg cases around :500-1100)."""
+
+    def _signed_vd(self, signer, data, *, forge=False):
+        from consensus_tpu.wire import SignedViewData, encode_view_data
+
+        raw = encode_view_data(data)
+        value = b"sig-%d" % (signer if not forge else signer + 1)
+        return SignedViewData(signer=signer, raw_view_data=raw, signature=value)
+
+    def _start_change(self, vc, sched):
+        from consensus_tpu.wire import ViewChange as VC
+
+        # Bring the changer into "collecting ViewData for view 1" as the
+        # next leader (self_id 2 leads view 1 without rotation).
+        vc.start(0)
+        for sender in (1, 3, 4):
+            vc.handle_message(sender, VC(next_view=1))
+        sched.advance(0.1)
+
+    def test_view_data_to_non_leader_ignored(self):
+        vc, sched, comm, controller, timer = _make_vc()
+        # Without any view change, we are NOT the leader of view 0
+        # (leader of view 0 is node 1); a stray ViewData must be dropped.
+        data = vd(last_seq=0, next_view=0)
+        vc.handle_message(3, self._signed_vd(3, data))
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_view_data_with_forged_signature_rejected(self):
+        vc, sched, comm, controller, timer = _make_vc()
+        self._start_change(vc, sched)
+        data = vd(last_seq=0, next_view=1)
+        vc.handle_message(3, self._signed_vd(3, data, forge=True))
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_view_data_signer_sender_mismatch_rejected(self):
+        vc, sched, comm, controller, timer = _make_vc()
+        self._start_change(vc, sched)
+        data = vd(last_seq=0, next_view=1)
+        # Node 4 relays node 3's (validly signed) ViewData: must not count
+        # as node 4's vote, and must not count for 3 either (sender binding).
+        vc.handle_message(4, self._signed_vd(3, data))
+        assert vc._view_data_votes.get(4) is None
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_view_data_for_wrong_next_view_rejected(self):
+        vc, sched, comm, controller, timer = _make_vc()
+        self._start_change(vc, sched)
+        data = vd(last_seq=0, next_view=3)  # we are collecting for view 1
+        vc.handle_message(3, self._signed_vd(3, data))
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_new_view_with_undecodable_view_data_rejected(self):
+        from consensus_tpu.wire import NewView, SignedViewData
+
+        vc, sched, comm, controller, timer = _make_vc()
+        self._start_change(vc, sched)
+        bad = NewView(signed_view_data=(
+            SignedViewData(signer=1, raw_view_data=b"\xff\xff", signature=b"sig-1"),
+        ))
+        before = controller.changed[:]
+        vc._process_new_view(bad)
+        assert controller.changed == before
+        vc.stop()
+
+    def test_new_view_duplicate_signers_not_counted_twice(self):
+        from consensus_tpu.wire import NewView
+
+        vc, sched, comm, controller, timer = _make_vc()
+        self._start_change(vc, sched)
+        data = vd(last_seq=0, next_view=1)
+        svd3 = self._signed_vd(3, data)
+        bad = NewView(signed_view_data=(svd3, svd3, svd3))  # 1 unique < quorum
+        before = controller.changed[:]
+        vc._process_new_view(bad)
+        assert controller.changed == before
+        vc.stop()
+
+    def test_new_view_with_quorum_of_valid_view_data_installs(self):
+        from consensus_tpu.wire import NewView
+
+        vc, sched, comm, controller, timer = _make_vc()
+        self._start_change(vc, sched)
+        # Genesis ViewData (empty last decision) matches our checkpoint.
+        data = vd(next_view=1)
+        nv = NewView(signed_view_data=tuple(
+            self._signed_vd(s, data) for s in (1, 3, 4)
+        ))
+        vc._process_new_view(nv)
+        assert controller.changed, "quorum NewView must install the view"
+        assert vc.real_view == 1
+        vc.stop()
