@@ -156,3 +156,104 @@ def test_randomized_fault_soak_n7_two_faults():
         max_time=900.0,
     ), "n=7 cluster failed to make progress after healing"
     cluster.assert_ledgers_consistent()
+
+
+def _run_targeted_chaos(seed, n):
+    """Message-type-targeted chaos: random drop rules per wire kind (up to
+    total loss of e.g. every NewView or every Commit), plus crashes and
+    partitions — a sharper fault model than uniform loss, and the one that
+    exposed the assist-flagged recovery-rebroadcast bug."""
+    from consensus_tpu.wire import (
+        Commit,
+        HeartBeat,
+        NewView,
+        PrePrepare,
+        Prepare,
+        StateTransferRequest,
+        StateTransferResponse,
+        ViewChange,
+    )
+
+    kinds = [Prepare, Commit, PrePrepare, HeartBeat, NewView, ViewChange,
+             StateTransferRequest, StateTransferResponse]
+    rng = random.Random(seed)
+    cluster = Cluster(n, seed=seed ^ 0x5A5A, config_tweaks=FAST)
+    cluster.start()
+    submitted = 0
+    crashed: set[int] = set()
+    drop_rules: dict = {}
+
+    def submit_some(k):
+        nonlocal submitted
+        for _ in range(k):
+            cluster.submit_to_all(make_request("chaos", submitted))
+            submitted += 1
+
+    def mutate(sender, target, msg):
+        p = drop_rules.get(type(msg))
+        if p and rng.random() < p:
+            return None
+        return msg
+
+    cluster.network.mutate_send = mutate
+    submit_some(4)
+    assert cluster.run_until_ledger(1, max_time=300.0)
+    f = (n - 1) // 3
+    for _ in range(30):
+        roll = rng.random()
+        if roll < 0.2 and len(crashed) < f:
+            victim = rng.choice([i for i in cluster.nodes if i not in crashed])
+            cluster.nodes[victim].crash()
+            crashed.add(victim)
+        elif roll < 0.4 and crashed:
+            cluster.nodes[crashed.pop()].restart()
+        elif roll < 0.6:
+            drop_rules[rng.choice(kinds)] = rng.choice([0.3, 0.7, 1.0])
+        elif roll < 0.75:
+            drop_rules.clear()
+        elif roll < 0.85 and not crashed:
+            cluster.network.partition([rng.choice(list(cluster.nodes))])
+        else:
+            cluster.network.heal()
+        submit_some(rng.randrange(1, 4))
+        cluster.scheduler.advance(rng.uniform(5.0, 40.0))
+        # SAFETY under every fault mix: no fork, no double delivery.
+        cluster.assert_ledgers_consistent()
+        for node in cluster.nodes.values():
+            digests = [d.proposal.digest() for d in node.app.ledger]
+            assert len(digests) == len(set(digests)), (
+                f"replica {node.node_id} delivered a proposal twice"
+            )
+    #
+
+    drop_rules.clear()
+    cluster.network.heal()
+    cluster.network.mutate_send = None
+    for nid in list(crashed):
+        cluster.nodes[nid].restart()
+    cluster.scheduler.advance(60.0)
+    floor = max(len(nd.app.ledger) for nd in cluster.nodes.values())
+    submit_some(5)
+    assert cluster.scheduler.run_until(
+        lambda: sum(
+            1 for nd in cluster.nodes.values()
+            if len(nd.app.ledger) >= floor + 1
+        ) >= n - f,
+        max_time=1200.0,
+    ), "cluster failed to progress after the chaos healed"
+    cluster.assert_ledgers_consistent()
+
+
+@pytest.mark.parametrize("seed,n", [(1, 4), (2, 7), (3, 4), (5, 7)])
+def test_targeted_message_chaos(seed, n):
+    _run_targeted_chaos(seed, n)
+
+
+@pytest.mark.skipif(
+    os.environ.get("CTPU_SOAK") != "1",
+    reason="wide chaos sweep is opt-in: set CTPU_SOAK=1",
+)
+@pytest.mark.parametrize("seed", list(range(200, 220)))
+@pytest.mark.parametrize("n", [4, 7])
+def test_targeted_message_chaos_sweep(seed, n):
+    _run_targeted_chaos(seed, n)
