@@ -81,6 +81,24 @@ class PersistedState:
         self._in_flight = in_flight
         #: Raw WAL entries read at boot (the restore source).
         self.entries = list(entries)
+        #: In-memory WAL tail for MID-RUN view restarts (see
+        #: reseed_if_inflight_matches): the latest persisted pre-prepare
+        #: and, if one followed it, our commit for it.
+        self._mem_proposed: Optional[ProposedRecord] = None
+        self._mem_commit: Optional[SavedCommit] = None
+        try:
+            last = self._last_record()
+            if isinstance(last, SavedCommit) and len(self.entries) >= 2:
+                prev = decode_saved(self.entries[-2])
+                if isinstance(prev, ProposedRecord):
+                    self._mem_proposed, self._mem_commit = prev, last
+            elif isinstance(last, ProposedRecord):
+                self._mem_proposed = last
+        except Exception:
+            # A torn/corrupt tail must not fail boot here: restore() has
+            # its own tolerant handling ("starting clean"), and with no
+            # mem-tail the reseed guard simply never fires.
+            logger.exception("WAL mem-tail seeding failed; reseed disabled")
 
     # --- saving ------------------------------------------------------------
 
@@ -93,8 +111,10 @@ class PersistedState:
         proposal is then stably decided (reference state.go:38-59)."""
         if isinstance(record, ProposedRecord):
             self._in_flight.store_proposal(record.pre_prepare.proposal)
+            self._mem_proposed, self._mem_commit = record, None
         elif isinstance(record, SavedCommit):
             self._in_flight.store_prepared(record.commit.view, record.commit.seq)
+            self._mem_commit = record
         self._wal.append(
             encode_saved(record),
             truncate_to=isinstance(record, ProposedRecord),
@@ -147,18 +167,44 @@ class PersistedState:
     def _recover_proposed(self, record: ProposedRecord, view: View) -> None:
         pp = record.pre_prepare
         self._in_flight.store_proposal(pp.proposal)
-        view.in_flight_proposal = pp.proposal
         view.number = pp.view
         view.proposal_sequence = pp.seq
+        self._enter_proposed(record, view)
+        logger.info("restored into PROPOSED at seq %d", pp.seq)
+
+    def _enter_proposed(self, record: ProposedRecord, view: View) -> None:
+        """Shared phase-reentry: seed ``view`` into PROPOSED from a
+        persisted pre-prepare (used by boot restore AND the mid-run
+        reseed guard — one body for the safety-critical invariant)."""
+        pp = record.pre_prepare
+        self._in_flight.store_proposal(pp.proposal)
+        view.in_flight_proposal = pp.proposal
         md = decode_view_metadata(pp.proposal.metadata)
         view.decisions_in_view = md.decisions_in_view
         view.phase = Phase.PROPOSED
-        # The prepare we must re-broadcast on start.
         p = record.prepare
         view._curr_prepare_sent = Prepare(
             view=p.view, seq=p.seq, digest=p.digest, assist=True
         )
-        logger.info("restored into PROPOSED at seq %d", pp.seq)
+
+    def _enter_prepared(self, record: ProposedRecord, commit, view: View) -> None:
+        """Shared phase-reentry: seed ``view`` into PREPARED from a
+        persisted pre-prepare + our commit."""
+        pp = record.pre_prepare
+        self._in_flight.store_proposal(pp.proposal)
+        self._in_flight.store_prepared(commit.view, commit.seq)
+        view.in_flight_proposal = pp.proposal
+        md = decode_view_metadata(pp.proposal.metadata)
+        view.decisions_in_view = md.decisions_in_view
+        view.my_commit_signature = commit.signature
+        view.phase = Phase.PREPARED
+        view._curr_commit_sent = Commit(
+            view=commit.view,
+            seq=commit.seq,
+            digest=commit.digest,
+            signature=commit.signature,
+            assist=True,
+        )
 
     def _recover_prepared(self, record: SavedCommit, view: View) -> None:
         commit = record.commit
@@ -177,23 +223,47 @@ class PersistedState:
         if view.proposal_sequence > pp.seq:
             logger.info("seq %d already safely committed", view.proposal_sequence)
             return
-        self._in_flight.store_proposal(pp.proposal)
-        self._in_flight.store_prepared(commit.view, commit.seq)
-        view.in_flight_proposal = pp.proposal
         view.number = pp.view
         view.proposal_sequence = pp.seq
-        md = decode_view_metadata(pp.proposal.metadata)
-        view.decisions_in_view = md.decisions_in_view
-        view.my_commit_signature = commit.signature
-        view.phase = Phase.PREPARED
-        view._curr_commit_sent = Commit(
-            view=commit.view,
-            seq=commit.seq,
-            digest=commit.digest,
-            signature=commit.signature,
-            assist=True,
-        )
+        self._enter_prepared(prev, commit, view)
         logger.info("restored into PREPARED at seq %d", pp.seq)
+
+
+    def reseed_if_inflight_matches(self, view: "View") -> None:
+        """Equivocation guard for MID-RUN view restarts (the boot restore
+        runs once; this runs on every later view start): if the view being
+        started sits at EXACTLY the (view, seq) we persisted a pre-prepare
+        (and possibly our commit) for, the fresh View object must resume
+        from that state.  Starting clean would let this replica prepare a
+        DIFFERENT proposal at the same (view, seq) — and a sync-with-
+        nothing-new restarting the current view does exactly that on every
+        stalled replica at once, which is a quorum of equivocators and a
+        forked ledger (found by the targeted-chaos soak, seed 114: two
+        proposals both "committed" at the same view/seq with overlapping
+        signers).  Restarts at a different view or sequence are untouched:
+        cross-view safety belongs to the view-change protocol
+        (check_in_flight + the embedded re-commit view)."""
+        rec = self._mem_proposed
+        if rec is None:
+            return
+        pp = rec.pre_prepare
+        if pp.view != view.number or pp.seq != view.proposal_sequence:
+            return
+        commit = self._mem_commit
+        if commit is not None and (
+            commit.commit.view != pp.view or commit.commit.seq != pp.seq
+        ):
+            commit = None
+        if commit is None:
+            self._enter_proposed(rec, view)
+            logger.info(
+                "reseeded restarted view into PROPOSED at (%d, %d)", pp.view, pp.seq
+            )
+        else:
+            self._enter_prepared(rec, commit.commit, view)
+            logger.info(
+                "reseeded restarted view into PREPARED at (%d, %d)", pp.view, pp.seq
+            )
 
 
 class ProposalMaker:
@@ -226,7 +296,9 @@ class ProposalMaker:
             number=view_number,
             decisions_in_view=decisions_in_view,
         )
-        if not self._restored_once:
+        if self._restored_once:
+            self._state.reseed_if_inflight_matches(view)
+        else:
             self._restored_once = True
             try:
                 self._state.restore(view)

@@ -271,3 +271,68 @@ def test_committed_sequence_not_delivered_twice_through_sync_storm():
     assert cluster.run_until_ledger(5, node_ids=[2, 3, 4], max_time=900.0)
     cluster.assert_ledgers_consistent()
     _assert_no_double_delivery(cluster)
+
+
+def test_sync_restart_of_current_view_cannot_equivocate():
+    """THE seed-114 fork, deterministically.  Every Commit is dropped, so
+    all replicas sit PREPARED on proposal P at (view 0, seq 2).  Then each
+    replica's view is restarted at that same slot via change_view — exactly
+    what the sync path does when a churned fetch-state outcome lands on the
+    current view with a different decisions-in-view count.  A restarted
+    view that comes up CLEAN lets its leader propose (and the others
+    prepare) a DIFFERENT proposal P' at the same (view, seq): a quorum of
+    equivocators, and node-by-node commit divergence.  The restart must
+    instead reseed from the persisted pre-prepare/commit."""
+    from consensus_tpu.wire import Commit as WireCommit
+
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    def drop_commits(sender, target, msg):
+        if isinstance(msg, WireCommit):
+            return None
+        return msg
+
+    cluster.network.mutate_send = drop_commits
+    cluster.submit_to_all(make_request("c", 1))
+    cluster.scheduler.advance(3.0)  # pre-prepare + prepares land everywhere
+
+    digests = set()
+    for node in cluster.nodes.values():
+        view = node.consensus.controller.curr_view
+        assert view.in_flight_proposal is not None
+        digests.add(view.in_flight_proposal.digest())
+    assert len(digests) == 1, "setup: all must be prepared on one proposal"
+    (original_digest,) = digests
+
+    # More requests arrive (a clean re-proposal at seq 2 would batch these
+    # and differ from P), then every replica restarts its CURRENT view at
+    # the SAME slot (the churned-sync outcome).
+    cluster.submit_to_all(make_request("c", 2))
+    cluster.scheduler.advance(0.5)
+    for node in cluster.nodes.values():
+        node.consensus.controller.change_view(0, 2, 2)
+    cluster.scheduler.advance(10.0)
+
+    # No replica may now hold a different proposal at (0, 2).
+    for nid, node in cluster.nodes.items():
+        view = node.consensus.controller.curr_view
+        if view is not None and view.in_flight_proposal is not None:
+            assert view.in_flight_proposal.digest() == original_digest, (
+                f"replica {nid} equivocated at the restarted slot"
+            )
+
+    # Heal: the cluster must commit THE ORIGINAL proposal at seq 2.
+    cluster.network.mutate_send = None
+    assert cluster.scheduler.run_until(
+        lambda: all(len(n.app.ledger) >= 2 for n in cluster.nodes.values()),
+        max_time=900.0,
+    ), "cluster stalled after commits were unjammed"
+    for node in cluster.nodes.values():
+        assert node.app.ledger[1].proposal.digest() == original_digest, (
+            f"replica {node.node_id} committed a different proposal at seq 2"
+        )
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)
