@@ -34,13 +34,18 @@ _REQ_TAG = b"ctpu/request"
 
 
 def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
-                 pad_to: int):
-    """Returns (replica signers, verifier factory, engine, client keyring)."""
+                 wave: int, pad_to: int, coalesce: bool, window: float):
+    """Returns (replica signers, verifier factory, engine, raw engine,
+    min_device_batch, client keyring).  ``engine`` is what the replicas
+    use; when coalescing is on it is a :class:`ThreadCoalescingVerifier`
+    wrapper that merges the n replicas' concurrent verify waves into single
+    device launches (``raw_engine`` stays available for shape warm-up)."""
     from consensus_tpu.models import (
         EcdsaP256Signer,
         EcdsaP256VerifierMixin,
         Ed25519Signer,
         Ed25519VerifierMixin,
+        ThreadCoalescingVerifier,
     )
     from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
     from consensus_tpu.models.ed25519 import Ed25519BatchVerifier
@@ -51,20 +56,42 @@ def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
     # to the host too — kernel launch + tunnel latency dominates below
     # min_device_batch — and pads every device batch to ONE fixed shape
     # (pad_to) so no mid-run XLA compile can stall a replica thread.
-    min_dev = 10**9 if verify_mode == "host" else 32
+    if verify_mode == "host":
+        min_dev = 10**9
+    elif coalesce:
+        # Coalesced flushes below this ride OpenSSL faster than a padded
+        # pad_to-shape launch would run (host ~7-35k sigs/s vs the fixed
+        # launch+pad cost); the proposal wave (n*batch) goes device.
+        min_dev = 512
+    else:
+        min_dev = 32
     kw = dict(min_device_batch=min_dev, pad_to=pad_to)
     if family == "ed25519":
-        engine = Ed25519BatchVerifier(**kw)
+        raw_engine = Ed25519BatchVerifier(**kw)
         signers = {i: Ed25519Signer(i) for i in node_ids}
         clients = ClientKeyring([Ed25519Signer(1000 + i) for i in range(n_clients)])
         mixin_cls = Ed25519VerifierMixin
     elif family == "p256":
-        engine = EcdsaP256BatchVerifier(**kw)
+        raw_engine = EcdsaP256BatchVerifier(**kw)
         signers = {i: EcdsaP256Signer(i) for i in node_ids}
         clients = ClientKeyring([EcdsaP256Signer(1000 + i) for i in range(n_clients)])
         mixin_cls = EcdsaP256VerifierMixin
     else:
         raise ValueError(family)
+
+    engine = raw_engine
+    if verify_mode == "device" and coalesce:
+        # Flush as soon as the full n-replica wave has arrived (max_batch =
+        # wave), never launch beyond the one compiled shape (hard_cap), and
+        # let sub-device-size checks (heartbeats, quorum votes) skip the
+        # window entirely — merging only pays off for device launches.
+        engine = ThreadCoalescingVerifier(
+            raw_engine,
+            window=window,
+            max_batch=wave,
+            hard_cap=pad_to,
+            bypass_below=min_dev,
+        )
 
     keys = {i: s.public_bytes for i, s in signers.items()}
 
@@ -84,7 +111,7 @@ def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
     def make_verifier():
         return _SigVerifier(keys, engine=engine)
 
-    return signers, make_verifier, engine, clients
+    return signers, make_verifier, engine, raw_engine, min_dev, clients
 
 
 def main() -> None:
@@ -104,6 +131,21 @@ def main() -> None:
         "n=10, --rotate 100); 0 = rotation off",
     )
     ap.add_argument("--presign", type=int, default=100000)
+    ap.add_argument(
+        "--coalesce",
+        choices=["on", "off"],
+        default="on",
+        help="merge the n replicas' concurrent device verify calls into "
+        "single launches (device mode only; 'off' = one launch per replica "
+        "per proposal, each paying full dispatch overhead)",
+    )
+    ap.add_argument(
+        "--window",
+        type=float,
+        default=0.010,
+        help="coalescing window in seconds (must stay well under the "
+        "heartbeat/view-change timeouts; SURVEY §7 hard part 3)",
+    )
     ap.add_argument(
         "--platform",
         default=None,
@@ -126,9 +168,14 @@ def main() -> None:
     from consensus_tpu.models.ed25519 import _next_pow2
 
     node_ids = list(range(1, args.n + 1))
-    pad_to = _next_pow2(args.batch)
-    signers, make_verifier, engine, clients = build_family(
-        args.family, node_ids, args.clients, args.verify, pad_to
+    coalesce = args.coalesce == "on" and args.verify == "device"
+    # With coalescing the steady-state device launch is the n replicas'
+    # proposal wave (n * batch signatures); without it, one replica's batch.
+    wave = args.n * args.batch if coalesce else args.batch
+    pad_to = _next_pow2(wave)
+    signers, make_verifier, engine, raw_engine, min_dev, clients = build_family(
+        args.family, node_ids, args.clients, args.verify, wave, pad_to,
+        coalesce, args.window,
     )
     sig_len = 64
 
@@ -138,16 +185,23 @@ def main() -> None:
         clients.make_request(i % args.clients, i) for i in range(args.presign)
     ]
 
-    if args.verify == "device":
+    warm_n = min(pad_to, len(presigned))
+    if args.verify == "device" and wave >= min_dev and warm_n < min_dev:
+        ap.error(
+            f"--presign {args.presign} is too small to warm the device "
+            f"shape (need >= {min_dev}); raise --presign"
+        )
+    if args.verify == "device" and wave >= min_dev:
         # Warm the ONE kernel shape (pad_to) BEFORE consensus starts: a
         # first-compile stall inside a replica thread trips heartbeat
         # timeouts and the cluster spends the benchmark in view changes.
-        warm = presigned[: args.batch]
+        # (When even the full wave rides the host path, nothing to warm.)
+        warm = presigned[:warm_n]
         t0 = time.time()
         raws = [r[:-sig_len] for r in warm]
         sigs = [r[-sig_len:] for r in warm]
         keys = [clients.public_keys[i % args.clients] for i in range(len(warm))]
-        ok = engine.verify_batch([_REQ_TAG + r for r in raws], sigs, keys)
+        ok = raw_engine.verify_batch([_REQ_TAG + r for r in raws], sigs, keys)
         assert ok.all(), "warmup requests failed to verify"
         print(
             f"# kernel warm ({len(warm)} sigs -> shape {pad_to}) "
@@ -232,6 +286,7 @@ def main() -> None:
                 "f": (args.n - 1) // 3,
                 "batch": args.batch,
                 "rotate_every": args.rotate,
+                "coalesce": coalesce,
                 "blocks_per_sec": round((end_blocks - start_blocks) / elapsed, 1),
                 "p50_commit_latency_ms": pct(0.50),
                 "p90_commit_latency_ms": pct(0.90),

@@ -2,7 +2,7 @@
 
 from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
 from consensus_tpu.models.ed25519 import Ed25519BatchVerifier, L
-from consensus_tpu.models.engine import BatchCoalescer
+from consensus_tpu.models.engine import BatchCoalescer, ThreadCoalescingVerifier
 from consensus_tpu.models.verifier import (
     EcdsaP256Signer,
     EcdsaP256VerifierMixin,
@@ -19,6 +19,7 @@ __all__ = [
     "Ed25519BatchVerifier",
     "L",
     "BatchCoalescer",
+    "ThreadCoalescingVerifier",
     "Ed25519Signer",
     "Ed25519VerifierMixin",
     "commit_message",

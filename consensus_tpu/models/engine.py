@@ -12,9 +12,29 @@ network RTT to not hurt p50 commit latency (SURVEY §7 hard part 3).
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Callable, Optional, Sequence
 
+import numpy as np
+
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
+
+
+def _split_results(results: Sequence, sizes: Sequence[int]):
+    """Slice a merged result vector back into per-submission pieces,
+    refusing short results (a truncated slice must never read as 'all
+    valid' downstream)."""
+    total = sum(sizes)
+    if len(results) != total:
+        raise ValueError(
+            f"run_batch returned {len(results)} results for {total} items"
+        )
+    out, offset = [], 0
+    for size in sizes:
+        out.append(results[offset : offset + size])
+        offset += size
+    return out
 
 
 class BatchCoalescer:
@@ -68,18 +88,184 @@ class BatchCoalescer:
         for items, _ in pending:
             merged.extend(items)
         results = self._run_batch(merged)
-        if len(results) != len(merged):
-            raise ValueError(
-                f"run_batch returned {len(results)} results for {len(merged)} items"
-            )
-        offset = 0
-        for items, on_results in pending:
-            on_results(results[offset : offset + len(items)])
-            offset += len(items)
+        slices = _split_results(results, [len(items) for items, _ in pending])
+        for (_, on_results), piece in zip(pending, slices):
+            on_results(piece)
 
     @property
     def pending_count(self) -> int:
         return self._pending_count
 
 
-__all__ = ["BatchCoalescer"]
+class _Pending:
+    __slots__ = ("messages", "signatures", "keys", "done", "result", "error")
+
+    def __init__(self, messages, signatures, keys):
+        self.messages = messages
+        self.signatures = signatures
+        self.keys = keys
+        self.done = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class ThreadCoalescingVerifier:
+    """Thread-safe verify coalescer for replicas *sharing one device*.
+
+    In a deployment where several replica threads (or processes behind a
+    sidecar) share a single TPU, each replica independently batch-verifies
+    the same proposal's signatures — n device launches per decision, each
+    paying the fixed dispatch/transfer overhead.  This wrapper merges
+    concurrent ``verify_batch`` calls from any thread into one kernel
+    launch: submissions wait up to ``window`` seconds (or until
+    ``max_batch`` signatures are pending) and ride a single padded device
+    call, then each caller gets its own slice of the results.
+
+    The per-replica semantics are unchanged — every replica still checks
+    exactly the signatures it chose to check; only the *execution* is
+    fused.  (The reference has no equivalent: each Go replica burns its own
+    cores — reference internal/bft/view.go:537-541.)
+
+    ``hard_cap`` bounds a single launch (whole submissions are never
+    split); overflow waits for the next flush.  Set it to the engine's
+    ``pad_to`` so a mid-run launch can never hit a never-compiled shape.
+    Submissions larger than ``hard_cap`` are chunked and enqueued together
+    (they share flushes; results are re-concatenated for the caller).
+
+    ``bypass_below``: submissions smaller than this go straight to the
+    wrapped engine on the caller's thread with NO window wait.  Merging
+    only pays off for *device* launches (amortizing dispatch overhead);
+    host-path work gains nothing from fusion, so single-signature checks
+    (heartbeats, view-change messages, quorum votes) shouldn't pay the
+    window latency.  Match it to the engine's ``min_device_batch``.
+
+    ``wait_timeout``: a wedged device (e.g. a hung TPU tunnel) must fail
+    loudly, not block every replica thread forever — waiters raise after
+    this many seconds.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        window: float = 0.010,
+        max_batch: int = 8192,
+        hard_cap: int = 0,
+        bypass_below: int = 0,
+        wait_timeout: float = 300.0,
+        name: str = "verify-coalescer",
+    ) -> None:
+        self._engine = engine
+        self._window = window
+        self._max_batch = max_batch
+        self._hard_cap = hard_cap if hard_cap > 0 else max(max_batch, 1)
+        self._bypass_below = bypass_below
+        self._wait_timeout = wait_timeout
+        self._cv = threading.Condition()
+        self._pending: list[_Pending] = []
+        self._count = 0
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
+        self._thread.start()
+
+    def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
+        n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            raise ValueError("batch length mismatch")
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if n < self._bypass_below:
+            # Too small to ever ride the device: verify on the caller's
+            # thread, zero added latency (the engine routes it host-side).
+            return np.asarray(self._engine.verify_batch(messages, signatures, public_keys))
+        # Chunk oversized submissions so no launch exceeds the compiled
+        # shape, enqueueing ALL chunks before waiting on any (they may
+        # share flushes — waiting per-chunk would serialize windows).
+        cap = self._hard_cap
+        items = [
+            _Pending(
+                list(messages[i : i + cap]),
+                list(signatures[i : i + cap]),
+                list(public_keys[i : i + cap]),
+            )
+            for i in range(0, n, cap)
+        ]
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("coalescer is closed")
+            for item in items:
+                self._pending.append(item)
+                self._count += len(item.messages)
+            self._cv.notify_all()
+        for item in items:
+            if not item.done.wait(timeout=self._wait_timeout):
+                raise RuntimeError(
+                    f"verify flush did not complete within {self._wait_timeout}s "
+                    "(wedged device?)"
+                )
+            if item.error is not None:
+                raise item.error
+        if len(items) == 1:
+            return items[0].result
+        return np.concatenate([item.result for item in items])
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            raise RuntimeError("coalescer flusher did not exit (wedged device?)")
+
+    # -- flusher thread ----------------------------------------------------
+
+    def _take_batch(self) -> list[_Pending]:
+        """Pop whole pending submissions up to ``hard_cap`` signatures."""
+        taken, total = [], 0
+        while self._pending:
+            nxt = len(self._pending[0].messages)
+            if taken and total + nxt > self._hard_cap:
+                break
+            item = self._pending.pop(0)
+            taken.append(item)
+            total += nxt
+        self._count -= total
+        return taken
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending and self._closed:
+                    return
+                deadline = time.monotonic() + self._window
+                while self._count < self._max_batch and not self._closed:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                batch = self._take_batch()
+            if not batch:
+                continue
+            messages: list = []
+            signatures: list = []
+            keys: list = []
+            for item in batch:
+                messages.extend(item.messages)
+                signatures.extend(item.signatures)
+                keys.extend(item.keys)
+            try:
+                results = np.asarray(self._engine.verify_batch(messages, signatures, keys))
+                slices = _split_results(results, [len(i.messages) for i in batch])
+            except BaseException as exc:  # propagate to every waiter
+                for item in batch:
+                    item.error = exc
+                    item.done.set()
+                continue
+            for item, piece in zip(batch, slices):
+                item.result = piece
+                item.done.set()
+
+
+__all__ = ["BatchCoalescer", "ThreadCoalescingVerifier"]

@@ -386,6 +386,135 @@ class TestCoalescer:
         assert out == [[]]
 
 
+class TestThreadCoalescer:
+    """Cross-thread coalescer (shared-device deployments): merges concurrent
+    verify_batch calls from replica threads into single engine launches."""
+
+    class _Fake:
+        def __init__(self):
+            self.calls = []
+
+        def verify_batch(self, msgs, sigs, keys):
+            import numpy as np
+
+            self.calls.append(len(msgs))
+            # valid iff sig == b"good"
+            return np.array([s == b"good" for s in sigs], dtype=bool)
+
+    def _make(self, **kw):
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        fake = self._Fake()
+        return fake, ThreadCoalescingVerifier(fake, **kw)
+
+    def test_concurrent_callers_merge_and_get_their_slices(self):
+        import threading
+
+        fake, v = self._make(window=0.05, max_batch=30)
+        results = {}
+
+        def worker(i, sigs):
+            results[i] = list(
+                v.verify_batch([b"m"] * len(sigs), sigs, [b"k"] * len(sigs))
+            )
+
+        patterns = {
+            0: [b"good"] * 10,
+            1: [b"bad"] * 10,
+            2: [b"good", b"bad"] * 5,
+        }
+        threads = [
+            threading.Thread(target=worker, args=(i, p))
+            for i, p in patterns.items()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        # One merged launch (max_batch reached), per-caller slices correct.
+        assert fake.calls == [30]
+        assert results[0] == [True] * 10
+        assert results[1] == [False] * 10
+        assert results[2] == [True, False] * 5
+        v.close()
+
+    def test_hard_cap_splits_whole_submissions(self):
+        import threading
+
+        fake, v = self._make(window=0.01, max_batch=10, hard_cap=15)
+        done = []
+
+        def worker():
+            done.append(v.verify_batch([b"m"] * 10, [b"good"] * 10, [b"k"] * 10).all())
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        # 10 + 10 > hard_cap 15: two launches, submissions never split.
+        assert fake.calls == [10, 10]
+        assert done == [True, True]
+        v.close()
+
+    def test_engine_error_propagates_to_every_waiter(self):
+        import threading
+
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        class _Boom:
+            def verify_batch(self, m, s, k):
+                raise RuntimeError("device fell over")
+
+        v = ThreadCoalescingVerifier(_Boom(), window=0.01, max_batch=4)
+        errors = []
+
+        def worker():
+            try:
+                v.verify_batch([b"m"], [b"s"], [b"k"])
+            except RuntimeError as e:
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        assert errors == ["device fell over"] * 2
+        v.close()
+
+    def test_oversized_submission_is_chunked_not_overlaunched(self):
+        fake, v = self._make(window=0.005, max_batch=8, hard_cap=8)
+        out = v.verify_batch([b"m"] * 20, [b"good"] * 19 + [b"bad"], [b"k"] * 20)
+        assert len(out) == 20
+        assert out[:19].all() and not out[19]
+        assert max(fake.calls) <= 8  # never beyond the compiled shape
+        v.close()
+
+    def test_short_engine_result_errors_instead_of_validating(self):
+        import numpy as np
+        import pytest
+
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        class _Short:
+            def verify_batch(self, m, s, k):
+                return np.ones(len(m) - 1, dtype=bool)
+
+        v = ThreadCoalescingVerifier(_Short(), window=0.005, max_batch=4)
+        with pytest.raises(ValueError):
+            v.verify_batch([b"m"] * 2, [b"s"] * 2, [b"k"] * 2)
+        v.close()
+
+    def test_closed_coalescer_rejects_submissions(self):
+        import pytest
+
+        fake, v = self._make(window=0.01)
+        v.close()
+        with pytest.raises(RuntimeError):
+            v.verify_batch([b"m"], [b"s"], [b"k"])
+
+
 class TestSharding:
     def test_sharded_matches_single_device(self):
         import jax
