@@ -32,6 +32,12 @@ from benchmarks._harness import start_feeder, start_replicas, teardown
 
 _REQ_TAG = b"ctpu/request"
 
+#: Coalesced flushes below this ride OpenSSL faster than a padded
+#: device launch would run (host ~7-35k sigs/s vs the fixed launch+pad
+#: cost).  Coalescing can only ever reach the device when the full
+#: n-replica wave clears it.
+MIN_DEVICE_COALESCED = 512
+
 
 def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
                  wave: int, pad_to: int, coalesce: bool, window: float):
@@ -59,10 +65,7 @@ def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
     if verify_mode == "host":
         min_dev = 10**9
     elif coalesce:
-        # Coalesced flushes below this ride OpenSSL faster than a padded
-        # pad_to-shape launch would run (host ~7-35k sigs/s vs the fixed
-        # launch+pad cost); the proposal wave (n*batch) goes device.
-        min_dev = 512
+        min_dev = MIN_DEVICE_COALESCED
     else:
         min_dev = 32
     kw = dict(min_device_batch=min_dev, pad_to=pad_to)
@@ -83,14 +86,17 @@ def build_family(family: str, node_ids, n_clients: int, verify_mode: str,
     if verify_mode == "device" and coalesce:
         # Flush as soon as the full n-replica wave has arrived (max_batch =
         # wave), never launch beyond the one compiled shape (hard_cap), and
-        # let sub-device-size checks (heartbeats, quorum votes) skip the
-        # window entirely — merging only pays off for device launches.
+        # let genuinely tiny checks (heartbeats, quorum votes) skip the
+        # window.  bypass_below must stay SMALL: per-replica proposal
+        # batches below min_device_batch still belong in the coalescer —
+        # merging n of them is exactly what lifts the flush over the
+        # device threshold.
         engine = ThreadCoalescingVerifier(
             raw_engine,
             window=window,
             max_batch=wave,
             hard_cap=pad_to,
-            bypass_below=min_dev,
+            bypass_below=64,
         )
 
     keys = {i: s.public_bytes for i, s in signers.items()}
@@ -169,6 +175,11 @@ def main() -> None:
 
     node_ids = list(range(1, args.n + 1))
     coalesce = args.coalesce == "on" and args.verify == "device"
+    if coalesce and args.n * args.batch < MIN_DEVICE_COALESCED:
+        # Even the full merged wave would ride the host path — coalescing
+        # could only add window latency.  Fall back honestly (reported in
+        # the output JSON as coalesce=false).
+        coalesce = False
     # With coalescing the steady-state device launch is the n replicas'
     # proposal wave (n * batch signatures); without it, one replica's batch.
     wave = args.n * args.batch if coalesce else args.batch

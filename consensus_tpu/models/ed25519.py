@@ -60,19 +60,23 @@ def verify_impl(
     sign_r: jnp.ndarray,    # (batch,)    R.x sign bits
     y_a: jnp.ndarray,       # (32, batch) A.y limbs
     sign_a: jnp.ndarray,    # (batch,)    A.x sign bits
-    s_digits: jnp.ndarray,  # (64, batch) S 4-bit window digits, MSB window first
-    k_digits: jnp.ndarray,  # (64, batch) k 4-bit window digits
+    s_digits8: jnp.ndarray, # (32, batch) S 8-bit window digits, LSB window first
+    k_digits: jnp.ndarray,  # (64, batch) k 4-bit window digits, MSB window first
     host_ok: jnp.ndarray,   # (batch,)    host-side pre-checks passed
 ) -> jnp.ndarray:
     """Un-jitted kernel body — every op is independent per batch element
     (batch is the trailing axis, riding the vector lanes), so this function
     shards over the batch axis unchanged (see :mod:`consensus_tpu.parallel`).
 
-    The double-scalar multiply acc = [S]B + [k](-A) runs 4-bit windowed:
-    64 scan steps of 4 doubles + 2 table adds.  Tables: j*B is a broadcast
-    constant; j*(-A) is built per batch with 14 additions.  Lookups are
-    one-hot contractions (no gathers), and digit 0 adds the identity — the
-    complete addition formulas make that branch-free."""
+    acc = [S]B + [k](-A) is split by operand class: the variable half
+    [k](-A) runs a 4-bit-windowed Horner scan (64 steps of 4 doubles + 1
+    table add; j*(-A) built per batch with 14 additions), while the
+    fixed-base half [S]B — B is a compile-time constant — uses an 8-bit
+    comb over precomputed tables (:func:`consensus_tpu.ops.ed25519
+    .fixed_base_mul_comb`): 32 constant lookups + mixed adds, zero doubles,
+    with the lookups riding the MXU.  Lookups are one-hot contractions (no
+    gathers), and digit 0 adds the identity — the complete addition
+    formulas make that branch-free."""
     # Decompress R and A in ONE instance of the (large) decompression graph
     # by stacking them along the trailing batch axis — same total runtime
     # work, half the traced/compiled graph.
@@ -91,28 +95,25 @@ def verify_impl(
     )
     r_ok, a_ok = pt_ok[..., :batch], pt_ok[..., batch:]
     neg_a = ed.negate(a_point)
-    # *_like / table coords inherit the inputs' sharding variance so the
-    # scan carry type-checks under shard_map.
-    base_table = ed.base_table_like(y_r, _TABLE)
+    # The table coords inherit the inputs' sharding variance so the scan
+    # carry type-checks under shard_map.
     a_table = ed.multiples_table(neg_a, _TABLE)
 
     lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]  # (16, 1)
 
-    def step(acc: ed.Point, window):
-        s_d, k_d = window  # (batch,) digit indices
-        s_oh = (s_d[None] == lanes).astype(jnp.float32)  # (16, batch)
-        k_oh = (k_d[None] == lanes).astype(jnp.float32)
+    def step(acc: ed.Point, k_d):
+        k_oh = (k_d[None] == lanes).astype(jnp.float32)  # (16, batch)
         # 3 T-free doubles as an inner scan (one body in the graph) + the
         # final T-producing double — graph size, not runtime, economy.
         acc, _ = jax.lax.scan(
             lambda a, _: (ed.double(a, need_t=False), None), acc, None, length=3
         )
         acc = ed.double(acc)
-        acc = ed.add(acc, ed.table_lookup(base_table, s_oh))
         acc = ed.add(acc, ed.table_lookup(a_table, k_oh))
         return acc, None
 
-    acc, _ = jax.lax.scan(step, ed.identity_like(y_r), (s_digits, k_digits))
+    acc, _ = jax.lax.scan(step, ed.identity_like(y_r), k_digits)
+    acc = ed.add(acc, ed.fixed_base_mul_comb(s_digits8))
 
     return host_ok & r_ok & a_ok & ed.equal(acc, r_point)
 
@@ -161,15 +162,24 @@ def _bits_to_window_digits(bits: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(digits[:, ::-1].T)
 
 
+def _bits_to_comb_digits8(bits: np.ndarray) -> np.ndarray:
+    """(n, 256) LSB-first bit rows -> (32, n) 8-bit digits, LSB window
+    first (the comb sums windows, order-free)."""
+    weights = np.array([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.int32)
+    digits = bits.reshape(bits.shape[0], 32, 8) @ weights
+    return np.ascontiguousarray(digits.T)
+
+
 def to_kernel_layout(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok):
     """Host row-major arrays -> device layout: limbs/digits leading (on the
-    sublanes), batch trailing (on the lanes), windows MSB first."""
+    sublanes), batch trailing (on the lanes); S as 8-bit comb digits, k as
+    MSB-first 4-bit Horner digits."""
     return (
         jnp.asarray(np.ascontiguousarray(y_r.T)),
         jnp.asarray(sign_r),
         jnp.asarray(np.ascontiguousarray(y_a.T)),
         jnp.asarray(sign_a),
-        jnp.asarray(_bits_to_window_digits(s_bits)),
+        jnp.asarray(_bits_to_comb_digits8(s_bits)),
         jnp.asarray(_bits_to_window_digits(k_bits)),
         jnp.asarray(host_ok),
     )

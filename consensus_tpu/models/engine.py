@@ -204,7 +204,12 @@ class ThreadCoalescingVerifier:
                     "(wedged device?)"
                 )
             if item.error is not None:
-                raise item.error
+                # A merged flush fails for every waiter; raising the SAME
+                # exception object from N threads would interleave their
+                # frames into one shared traceback — wrap per waiter.
+                raise RuntimeError(
+                    f"coalesced verify flush failed: {item.error!r}"
+                ) from item.error
         if len(items) == 1:
             return items[0].result
         return np.concatenate([item.result for item in items])
@@ -213,7 +218,10 @@ class ThreadCoalescingVerifier:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
-        self._thread.join(timeout=5.0)
+        # A legitimate in-flight flush (first compile, big host pass) may
+        # run long — grant it the same budget as waiters before calling
+        # the device wedged.
+        self._thread.join(timeout=self._wait_timeout)
         if self._thread.is_alive():
             raise RuntimeError("coalescer flusher did not exit (wedged device?)")
 

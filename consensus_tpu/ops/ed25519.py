@@ -14,8 +14,10 @@ R points decompresses in two scans — no per-element host math.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from consensus_tpu.ops import field25519 as fe
@@ -148,7 +150,7 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> tuple[Point, jnp.ndar
 
     v3 = fe.mul(fe.square(v), v)
     v7 = fe.mul(fe.square(v3), v)
-    x = fe.mul(fe.mul(u, v3), fe.pow_const(fe.mul(u, v7), (fe.P - 5) // 8))
+    x = fe.mul(fe.mul(u, v3), fe.pow_2_252_m3(fe.mul(u, v7)))
 
     vx2 = fe.mul(v, fe.square(x))
     root_ok = fe.eq(vx2, u)
@@ -199,6 +201,86 @@ def base_point_table_ints(size: int = 16) -> list[tuple[int, int]]:
     return table
 
 
+_COMB_WINDOWS = 32
+_COMB_BITS = 8
+
+
+@functools.lru_cache(maxsize=1)
+def _comb_table_np() -> tuple["np.ndarray", "np.ndarray", "np.ndarray"]:
+    """Fixed-base comb: affine (x, y, t=xy) limb arrays of shape
+    (32 windows, 256 entries, 32 limbs) with ``T[j][d] = d * 2^(8j) * B``.
+
+    B is a compile-time constant, so [S]B needs NO doubles and NO per-batch
+    table build: 32 constant-table lookups + 31 adds, vs riding the shared
+    Horner scan (64 table adds).  Host-side integer precompute (~0.2 s,
+    cached for the process; the arrays are baked into the jitted graph as
+    constants)."""
+    import numpy as np
+
+    xs = np.zeros((_COMB_WINDOWS, 1 << _COMB_BITS, fe.LIMBS), dtype=np.float32)
+    ys = np.zeros_like(xs)
+    ts = np.zeros_like(xs)
+    window_base = (_BX, _BY)  # 2^(8j) * B
+    for j in range(_COMB_WINDOWS):
+        entry = (0, 1)  # identity
+        for d in range(1 << _COMB_BITS):
+            x, y = entry
+            xs[j, d] = fe.int_to_limbs(x)
+            ys[j, d] = fe.int_to_limbs(y)
+            ts[j, d] = fe.int_to_limbs(x * y % fe.P)
+            entry = _edwards_add_int(entry, window_base)
+        for _ in range(_COMB_BITS):
+            window_base = _edwards_add_int(window_base, window_base)
+    return xs, ys, ts
+
+
+def add_affine(p: Point, q_x: jnp.ndarray, q_y: jnp.ndarray, q_t: jnp.ndarray) -> Point:
+    """Mixed addition p + q with q affine (Z=1, T=XY given): madd-2008-hwcd-3
+    — 7M + 1 constant mul (the D = 2 Z1 Z2 multiply degenerates to a raw
+    doubling of p.z).  Same lazy-reduction discipline as :func:`add`."""
+    a = fe.mul(fe.sub_raw(p.y, p.x), fe.sub_raw(q_y, q_x))
+    b = fe.mul(fe.add_raw(p.y, p.x), fe.add_raw(q_y, q_x))
+    c = fe.mul(fe.mul(p.t, fe.constant_like(_D2, p.t)), q_t)
+    d = fe.add_raw(p.z, p.z)
+    e = fe.sub_raw(b, a)
+    f = fe.sub_raw(d, c)
+    g = fe.add_raw(d, c)
+    h = fe.add_raw(b, a)
+    return Point(x=fe.mul(e, f), y=fe.mul(g, h), z=fe.mul(f, g), t=fe.mul(e, h))
+
+
+def fixed_base_mul_comb(s_digits8: jnp.ndarray) -> Point:
+    """[S]B from 8-bit window digits ``s_digits8`` of shape (32, batch),
+    LSB window first: one constant-table lookup + one mixed add per window,
+    zero doubles.  The lookups are one-hot contractions against broadcast
+    constants — they lower to (256 x 128) x batch matmuls (MXU work), while
+    the adds stay on the VPU."""
+    xs, ys, ts = _comb_table_np()
+    lanes = jnp.arange(1 << _COMB_BITS, dtype=jnp.int32)[:, None]  # (256, 1)
+
+    # Stack the per-window tables as scan inputs, limbs trailing the entry
+    # axis: (32, 256, 32limbs, 1) broadcasting against (256, batch) one-hots.
+    def coords(arr) -> jnp.ndarray:
+        return jnp.asarray(arr)[..., None]  # (32, 256, 32, 1)
+
+    def step(acc: Point, inputs):
+        digits, tx, ty, tt = inputs  # (batch,), (256, 32, 1) x3
+        oh = (digits[None] == lanes).astype(jnp.float32)  # (256, batch)
+
+        def pick(tbl: jnp.ndarray) -> jnp.ndarray:
+            return jnp.sum(tbl * oh[:, None], axis=0)  # (32, batch)
+
+        return add_affine(acc, pick(tx), pick(ty), pick(tt)), None
+
+    # The (32, batch)-shaped digit array doubles as the identity's shape /
+    # sharding-variance reference (it IS (LIMBS, batch)).
+    ref = s_digits8.astype(jnp.float32)
+    acc, _ = jax.lax.scan(
+        step, identity_like(ref), (s_digits8, coords(xs), coords(ys), coords(ts))
+    )
+    return acc
+
+
 def table_lookup(table: Point, one_hot: jnp.ndarray) -> Point:
     """Select table[digit] per batch element via a one-hot contraction —
     pure VPU multiply-adds, no gather (TPU gathers serialize).
@@ -237,23 +319,6 @@ def multiples_table(p: Point, size: int = 16) -> Point:
     )
 
 
-def base_table_like(ref: jnp.ndarray, size: int = 16) -> Point:
-    """The constant j*B table, shaped (size, 32, 1...) to broadcast against
-    ``ref``-shaped batches."""
-    ints = base_point_table_ints(size)
-    ones = (1,) * (ref.ndim - 1)
-
-    def coords(values):
-        arr = jnp.stack([jnp.asarray(fe.int_to_limbs(v)) for v in values])
-        return (ref[None, :] * 0) + arr.reshape(size, fe.LIMBS, *ones)
-
-    xs = coords([x for x, _ in ints])
-    ys = coords([y for _, y in ints])
-    zs = coords([1] * size)
-    ts = coords([(x * y) % fe.P for x, y in ints])
-    return Point(x=xs, y=ys, z=zs, t=ts)
-
-
 __all__ = [
     "Point",
     "identity",
@@ -270,5 +335,6 @@ __all__ = [
     "base_point_table_ints",
     "table_lookup",
     "multiples_table",
-    "base_table_like",
+    "add_affine",
+    "fixed_base_mul_comb",
 ]

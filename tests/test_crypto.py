@@ -350,6 +350,25 @@ class TestVerifierPort:
             verifier.verify_signature(Signature(id=3, value=bytes(64), msg=data))
 
 
+class TestPowChain:
+    def test_addition_chain_matches_binary_ladder_and_bigint(self):
+        """pow_2_252_m3 (11-mul chain) == pow_const == python pow, incl.
+        edge cases 0, 1, p-1, sqrt(-1)."""
+        import jax
+        import numpy as np
+
+        from consensus_tpu.ops import field25519 as fe
+
+        rng = np.random.default_rng(7)
+        vals = [int.from_bytes(rng.bytes(32), "little") % fe.P for _ in range(4)]
+        vals += [0, 1, fe.P - 1, fe.SQRT_M1]
+        arr = np.stack([fe.int_to_limbs(v) for v in vals]).T.astype(np.float32)
+        x = jax.numpy.asarray(arr)
+        got = np.asarray(fe.freeze(jax.jit(fe.pow_2_252_m3)(x)))
+        for i, v in enumerate(vals):
+            assert fe.limbs_to_int(got[:, i]) == pow(v, (fe.P - 5) // 8, fe.P)
+
+
 class TestCoalescer:
     def test_merges_submissions_into_one_batch(self):
         s = SimScheduler()
@@ -473,14 +492,18 @@ class TestThreadCoalescer:
             try:
                 v.verify_batch([b"m"], [b"s"], [b"k"])
             except RuntimeError as e:
-                errors.append(str(e))
+                errors.append(f"{e} / cause: {e.__cause__}")
 
         threads = [threading.Thread(target=worker) for _ in range(2)]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=5.0)
-        assert errors == ["device fell over"] * 2
+        # Each waiter gets its OWN wrapper exception (a shared instance
+        # raised from N threads would interleave tracebacks), chaining the
+        # original engine failure as __cause__.
+        assert len(errors) == 2
+        assert all("device fell over" in e for e in errors)
         v.close()
 
     def test_oversized_submission_is_chunked_not_overlaunched(self):
@@ -502,8 +525,9 @@ class TestThreadCoalescer:
                 return np.ones(len(m) - 1, dtype=bool)
 
         v = ThreadCoalescingVerifier(_Short(), window=0.005, max_batch=4)
-        with pytest.raises(ValueError):
+        with pytest.raises(RuntimeError) as exc_info:
             v.verify_batch([b"m"] * 2, [b"s"] * 2, [b"k"] * 2)
+        assert isinstance(exc_info.value.__cause__, ValueError)
         v.close()
 
     def test_closed_coalescer_rejects_submissions(self):
