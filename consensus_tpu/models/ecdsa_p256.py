@@ -5,10 +5,11 @@ Same architecture as :mod:`consensus_tpu.models.ed25519`: the host parses,
 range-checks, hashes (SHA-256) and computes the scalar pair u1 = e/s,
 u2 = r/s (mod n, Python big-int — modular inversion of the *scalar* field
 is irregular host work); the device runs the regular 99%: an on-curve check
-for the public key and the fused double-scalar multiplication
-R' = u1*G + u2*Q as a 64-step 4-bit-window scan over complete P-256
-formulas, then the projective acceptance test X == r * Z (with the r + n
-second candidate when it exists).
+for the public key and the double-scalar multiplication R' = u1*G + u2*Q
+over complete P-256 formulas — [u2]Q as a 64-step 4-bit-window scan,
+[u1]G as an 8-bit fixed-base comb (zero doubles; G is a compile-time
+constant) — then the projective acceptance test X == r * Z (with the
+r + n second candidate when it exists).
 
 Native formats: signature = 64 bytes big-endian r || s; public key =
 65 bytes SEC1 uncompressed (0x04 || X || Y).  DER/cryptography interop
@@ -54,37 +55,51 @@ def _scalars_to_window_digits(values: list[int]) -> np.ndarray:
     return np.ascontiguousarray(digits[:, ::-1].T)
 
 
+def _scalars_to_comb_digits8(values: list[int]) -> np.ndarray:
+    """Scalars -> (32, n) 8-bit digits, LSB window first: with byte-sized
+    windows the little-endian bytes ARE the digits (the comb sums windows,
+    order-free)."""
+    n = len(values)
+    rows = np.zeros((n, 32), dtype=np.uint8)
+    for i, v in enumerate(values):
+        rows[i] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
+    return np.ascontiguousarray(rows.astype(np.int32).T)
+
+
 def verify_impl(
     qx: jnp.ndarray,        # (32, batch) public key X limbs
     qy: jnp.ndarray,        # (32, batch) public key Y limbs
-    u1_digits: jnp.ndarray, # (64, batch) windows of u1 = e/s mod n, MSB first
-    u2_digits: jnp.ndarray, # (64, batch) windows of u2 = r/s mod n
+    u1_digits: jnp.ndarray, # (32, batch) 8-bit comb digits of u1 = e/s, LSB first
+    u2_digits: jnp.ndarray, # (64, batch) 4-bit windows of u2 = r/s, MSB first
     r1: jnp.ndarray,        # (32, batch) r as field limbs
     r2: jnp.ndarray,        # (32, batch) r + n as field limbs (when valid)
     has_r2: jnp.ndarray,    # (batch,) whether r + n < p
     host_ok: jnp.ndarray,   # (batch,) host-side pre-checks passed
 ) -> jnp.ndarray:
-    """Un-jitted kernel body; shards over the trailing batch axis."""
+    """Un-jitted kernel body; shards over the trailing batch axis.
+
+    R' = u1*G + u2*Q split by operand class: the variable half [u2]Q runs
+    the 4-bit Horner scan (64 steps of 4 doubles + 1 table add; j*Q built
+    per batch); the fixed-base half [u1]G — G is a compile-time constant —
+    uses the 8-bit comb (:func:`consensus_tpu.ops.p256.fixed_base_mul_comb`):
+    32 constant lookups + adds, zero doubles, no per-batch table."""
     q = p256.affine_like(qx, qy)
     q_ok = p256.on_curve(qx, qy)
-    g_table = p256.base_table_like(qx, _TABLE)
     q_table = p256.multiples_table(q, _TABLE)
     lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]
 
-    def step(acc: p256.Point, window):
-        d1, d2 = window
-        oh1 = (d1[None] == lanes).astype(jnp.float32)
+    def step(acc: p256.Point, d2):
         oh2 = (d2[None] == lanes).astype(jnp.float32)
         # 4 doubles as an inner scan: one double body in the graph instead
         # of four (trace/compile-size economy, identical runtime schedule).
         acc, _ = jax.lax.scan(
             lambda a, _: (p256.double(a), None), acc, None, length=4
         )
-        acc = p256.add(acc, p256.table_lookup(g_table, oh1))
         acc = p256.add(acc, p256.table_lookup(q_table, oh2))
         return acc, None
 
-    acc, _ = jax.lax.scan(step, p256.identity_like(qx), (u1_digits, u2_digits))
+    acc, _ = jax.lax.scan(step, p256.identity_like(qx), u2_digits)
+    acc = p256.add(acc, p256.fixed_base_mul_comb(u1_digits))
 
     # Accept iff R' is not the identity and x(R') ≡ r (mod n):
     # X == r * Z or (r + n < p and X == (r + n) * Z), projectively.
@@ -184,7 +199,7 @@ class EcdsaP256BatchVerifier:
         return (
             _be_bytes_to_limb_rows(qx_rows),
             _be_bytes_to_limb_rows(qy_rows),
-            _scalars_to_window_digits(u1s),
+            _scalars_to_comb_digits8(u1s),
             _scalars_to_window_digits(u2s),
             _be_bytes_to_limb_rows(r1_rows),
             _be_bytes_to_limb_rows(r2_rows),

@@ -10,6 +10,7 @@ P + P — exactly what a fixed-shape batched scan needs.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -174,46 +175,85 @@ def multiples_table(p: Point, size: int = 16) -> Point:
     )
 
 
-def _affine_table_ints(size: int = 16) -> list[tuple[int, int]]:
-    """Host-side integer multiples of G (identity encoded as (0, 0))."""
-
-    def add_int(p1, p2):
-        if p1 is None:
-            return p2
-        if p2 is None:
-            return p1
-        x1, y1 = p1
-        x2, y2 = p2
-        if x1 == x2 and (y1 + y2) % fp.P == 0:
-            return None
-        if p1 == p2:
-            lam = (3 * x1 * x1 - 3) * pow(2 * y1, fp.P - 2, fp.P) % fp.P
-        else:
-            lam = (y2 - y1) * pow(x2 - x1, fp.P - 2, fp.P) % fp.P
-        x3 = (lam * lam - x1 - x2) % fp.P
-        return x3, (lam * (x1 - x3) - y1) % fp.P
-
-    table = [None]
-    for _ in range(size - 1):
-        table.append(add_int(table[-1], (GX, GY)))
-    return [(0, 0) if e is None else e for e in table]
+def _add_int(p1, p2):
+    """Host-side affine integer point add (None = identity) for
+    constant-table generation."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % fp.P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1 - 3) * pow(2 * y1, fp.P - 2, fp.P) % fp.P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, fp.P - 2, fp.P) % fp.P
+    x3 = (lam * lam - x1 - x2) % fp.P
+    return x3, (lam * (x1 - x3) - y1) % fp.P
 
 
-def base_table_like(ref: jnp.ndarray, size: int = 16) -> Point:
-    """Constant j*G table with proper projective identity at index 0."""
+_COMB_WINDOWS = 32
+_COMB_BITS = 8
+
+
+@functools.lru_cache(maxsize=1)
+def _comb_table_np():
+    """Fixed-base comb for G: projective (x, y, z) limb arrays of shape
+    (32 windows, 256 entries, 32 limbs) with ``T[j][d] = d * 2^(8j) * G``
+    (z = 0 encodes the identity at d = 0 — P-256's projective identity
+    (0 : 1 : 0) has no affine form, so the comb adds stay the complete
+    projective formula rather than a mixed add).
+
+    G is a compile-time constant, so [u1]G needs NO doubles and NO
+    per-batch table build: 32 constant lookups + adds instead of riding
+    the Horner scan (64 table adds).  Host-side integer precompute
+    (~0.3 s, cached per process; baked into the graph as constants)."""
     import numpy as np
 
-    ints = _affine_table_ints(size)
-    ones = (1,) * (ref.ndim - 1)
+    xs = np.zeros((_COMB_WINDOWS, 1 << _COMB_BITS, fp.LIMBS), dtype=np.float32)
+    ys = np.zeros_like(xs)
+    zs = np.zeros_like(xs)
+    window_base = (GX, GY)  # 2^(8j) * G
+    for j in range(_COMB_WINDOWS):
+        entry = None
+        for d in range(1 << _COMB_BITS):
+            if entry is None:
+                ys[j, d] = fp.int_to_limbs(1)  # (0 : 1 : 0)
+            else:
+                xs[j, d] = fp.int_to_limbs(entry[0])
+                ys[j, d] = fp.int_to_limbs(entry[1])
+                zs[j, d] = fp.int_to_limbs(1)
+            entry = _add_int(entry, window_base)
+        for _ in range(_COMB_BITS):
+            window_base = _add_int(window_base, window_base)
+    return xs, ys, zs
 
-    def coords(values):
-        arr = jnp.stack([jnp.asarray(fp.int_to_limbs(v)) for v in values])
-        return (ref[None, :] * 0) + arr.reshape(size, fp.LIMBS, *ones)
 
-    xs = coords([x for x, _ in ints])
-    ys = coords([y if (x, y) != (0, 0) else 1 for x, y in ints])
-    zs = coords([0 if (x, y) == (0, 0) else 1 for x, y in ints])
-    return Point(x=xs, y=ys, z=zs)
+def fixed_base_mul_comb(digits8: jnp.ndarray) -> Point:
+    """[u]G from 8-bit window digits ``digits8`` of shape (32, batch), LSB
+    window first: one constant-table lookup (a one-hot contraction that
+    lowers to a matmul — MXU work) + one complete add per window, zero
+    doubles."""
+    import jax
+
+    xs, ys, zs = _comb_table_np()
+    lanes = jnp.arange(1 << _COMB_BITS, dtype=jnp.int32)[:, None]  # (256, 1)
+
+    def coords(arr) -> jnp.ndarray:
+        return jnp.asarray(arr)[..., None]  # (32, 256, 32, 1)
+
+    def step(acc: Point, inputs):
+        digits, tx, ty, tz = inputs
+        oh = (digits[None] == lanes).astype(jnp.float32)  # (256, batch)
+        return add(acc, table_lookup(Point(x=tx, y=ty, z=tz), oh)), None
+
+    ref = digits8.astype(jnp.float32)  # (32, batch) == (LIMBS, batch)
+    acc, _ = jax.lax.scan(
+        step, identity_like(ref), (digits8, coords(xs), coords(ys), coords(zs))
+    )
+    return acc
 
 
 def on_curve(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
@@ -240,6 +280,6 @@ __all__ = [
     "select",
     "table_lookup",
     "multiples_table",
-    "base_table_like",
+    "fixed_base_mul_comb",
     "on_curve",
 ]
