@@ -659,7 +659,11 @@ class View:
         commit signatures."""
         prev_proposal, prev_sigs = self._checkpoint.get()
         prev_md = self._decode_prev_metadata(prev_proposal)
-        black_list = tuple(prev_md.black_list)
+        # Rotation off clears any inherited blacklist (a downgraded cluster
+        # may still carry entries from its rotation era; followers reject
+        # rotation-inactive proposals with a non-empty blacklist).
+        # Parity: reference view.go:1019-1023.
+        black_list = tuple(prev_md.black_list) if self.decisions_per_leader > 0 else ()
 
         vseq = self._verifier.verification_sequence()
         membership_change = (
