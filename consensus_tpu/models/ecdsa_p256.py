@@ -39,8 +39,9 @@ _TABLE = 1 << _WINDOW_BITS
 
 
 def _be_bytes_to_limb_rows(rows_be: np.ndarray) -> np.ndarray:
-    """(n, 32) big-endian byte rows -> (n, 32) little-endian limb rows."""
-    return rows_be[:, ::-1].astype(np.float32)
+    """(n, 32) big-endian byte rows -> (n, 32) little-endian limb rows
+    (uint8 — the wire width; the kernel widens on device)."""
+    return rows_be[:, ::-1]
 
 
 def _scalars_to_window_digits(values: list[int]) -> np.ndarray:
@@ -52,7 +53,7 @@ def _scalars_to_window_digits(values: list[int]) -> np.ndarray:
     bits = np.unpackbits(rows, axis=-1, bitorder="little")  # (n, 256) LSB first
     weights = np.array([1, 2, 4, 8], dtype=np.int32)
     digits = bits.reshape(n, _WINDOWS, _WINDOW_BITS) @ weights
-    return np.ascontiguousarray(digits[:, ::-1].T)
+    return np.ascontiguousarray(digits[:, ::-1].T).astype(np.uint8)
 
 
 def _scalars_to_comb_digits8(values: list[int]) -> np.ndarray:
@@ -63,7 +64,7 @@ def _scalars_to_comb_digits8(values: list[int]) -> np.ndarray:
     rows = np.zeros((n, 32), dtype=np.uint8)
     for i, v in enumerate(values):
         rows[i] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
-    return np.ascontiguousarray(rows.astype(np.int32).T)
+    return np.ascontiguousarray(rows.T)
 
 
 def verify_impl(
@@ -83,6 +84,14 @@ def verify_impl(
     per batch); the fixed-base half [u1]G — G is a compile-time constant —
     uses the 8-bit comb (:func:`consensus_tpu.ops.p256.fixed_base_mul_comb`):
     32 constant lookups + adds, zero doubles, no per-batch table."""
+    # Inputs ship as uint8 (limbs/digits all fit) — 4x less transfer;
+    # widen to the compute dtypes on device.
+    qx = qx.astype(jnp.float32)
+    qy = qy.astype(jnp.float32)
+    u1_digits = u1_digits.astype(jnp.int32)
+    u2_digits = u2_digits.astype(jnp.int32)
+    r1 = r1.astype(jnp.float32)
+    r2 = r2.astype(jnp.float32)
     q = p256.affine_like(qx, qy)
     q_ok = p256.on_curve(qx, qy)
     q_table = p256.multiples_table(q, _TABLE)
@@ -129,7 +138,9 @@ def pad_prepared(prepped, padded: int):
 
 
 def to_kernel_layout(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok):
-    """Host row-major arrays -> device layout (vector axis leading)."""
+    """Host row-major arrays -> device layout (vector axis leading),
+    shipped as the narrowest dtype (uint8/bool); the kernel widens on
+    device."""
     return (
         jnp.asarray(np.ascontiguousarray(qx.T)),
         jnp.asarray(np.ascontiguousarray(qy.T)),

@@ -8,12 +8,13 @@ Split of labor:
   ``y < p``, hash ``k = SHA-512(R || A || M) mod L`` (hashing is
   variable-length and byte-oriented — the wrong shape for the MXU/VPU), and
   pack scalars/field elements into fixed-shape limb/bit arrays.
-* **Device** (the 99%: elliptic-curve math): decompress R and A, then one
-  fused double-scalar multiplication ``[S]B + [k](-A)`` via a 256-step
-  ``lax.scan`` (1 double + 2 selected adds per step, constant shape), and a
-  projective comparison against R.  Everything is int32 limb arithmetic
-  (:mod:`consensus_tpu.ops.field25519`) vmapped across the batch — one
-  compiled kernel per padded batch size verifies the whole quorum.
+* **Device** (the 99%: elliptic-curve math): decompress R and A, then the
+  double-scalar multiplication ``[S]B + [k](-A)`` — the variable half as a
+  64-step 4-bit-window ``lax.scan``, the fixed-base half as an 8-bit comb
+  over constant tables — and a projective comparison against R.  Everything
+  is f32 8-bit-limb arithmetic (:mod:`consensus_tpu.ops.field25519`)
+  batched on the trailing axis — one compiled kernel per padded batch size
+  verifies the whole quorum.  Inputs ship as uint8 (4x less transfer).
 
 Batches are padded to the next power of two (``Configuration.crypto_pad_pow2``)
 so XLA compiles a handful of shapes once and reuses them forever.
@@ -40,14 +41,10 @@ _SCALAR_BITS = 256
 
 
 def _bytes_rows_to_bits(rows: np.ndarray) -> np.ndarray:
-    """(n, 32) little-endian byte rows -> (n, 256) LSB-first bit rows."""
-    return np.unpackbits(rows, axis=-1, bitorder="little").astype(np.int32)
-
-
-def _bytes_rows_to_limbs(rows: np.ndarray) -> np.ndarray:
-    """(n, 32) little-endian byte rows -> (n, 32) 8-bit limb rows: with
-    byte-sized limbs the bytes ARE the limbs (bit 255 pre-masked)."""
-    return rows.astype(np.float32)
+    """(n, 32) little-endian byte rows -> (n, 256) LSB-first bit rows
+    (uint8 — every host-side array stays at the wire width; the kernel
+    widens on device)."""
+    return np.unpackbits(rows, axis=-1, bitorder="little")
 
 
 _WINDOW_BITS = 4
@@ -56,9 +53,9 @@ _TABLE = 1 << _WINDOW_BITS      # 16
 
 
 def verify_impl(
-    y_r: jnp.ndarray,       # (32, batch) R.y limbs (limbs-first layout, f32)
+    y_r: jnp.ndarray,       # (32, batch) R.y limbs, uint8 on the wire
     sign_r: jnp.ndarray,    # (batch,)    R.x sign bits
-    y_a: jnp.ndarray,       # (32, batch) A.y limbs
+    y_a: jnp.ndarray,       # (32, batch) A.y limbs, uint8 on the wire
     sign_a: jnp.ndarray,    # (batch,)    A.x sign bits
     s_digits8: jnp.ndarray, # (32, batch) S 8-bit window digits, LSB window first
     k_digits: jnp.ndarray,  # (64, batch) k 4-bit window digits, MSB window first
@@ -77,6 +74,15 @@ def verify_impl(
     with the lookups riding the MXU.  Lookups are one-hot contractions (no
     gathers), and digit 0 adds the identity — the complete addition
     formulas make that branch-free."""
+    # Inputs arrive in the narrowest dtype that holds them (uint8 limbs and
+    # digits) — 4x less host->device transfer, which rides a slow tunnel in
+    # the single-chip deployment.  Widen to the compute dtypes on device.
+    y_r = y_r.astype(jnp.float32)
+    y_a = y_a.astype(jnp.float32)
+    sign_r = sign_r.astype(jnp.int32)
+    sign_a = sign_a.astype(jnp.int32)
+    s_digits8 = s_digits8.astype(jnp.int32)
+    k_digits = k_digits.astype(jnp.int32)
     # Decompress R and A in ONE instance of the (large) decompression graph
     # by stacking them along the trailing batch axis — same total runtime
     # work, half the traced/compiled graph.
@@ -140,7 +146,7 @@ def _prep_compressed(points: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray, n
             chunks.append(b"\x00" * 32)
     # One bulk copy instead of n tiny frombuffer calls.
     rows = np.frombuffer(b"".join(chunks), dtype=np.uint8).reshape(n, 32)
-    signs = (rows[:, 31] >> 7).astype(np.int32)
+    signs = (rows[:, 31] >> 7)  # uint8
     rows = rows.copy()
     rows[:, 31] &= 0x7F
 
@@ -151,15 +157,15 @@ def _prep_compressed(points: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray, n
     lt = rows_be[np.arange(n), first] < _P_BYTES_BE[first]
     ok &= np.where(diff.any(axis=1), lt, False)  # y == p is out of range too
 
-    return _bytes_rows_to_limbs(rows), signs, ok
+    return rows, signs, ok  # byte-sized limbs: the bytes ARE the limbs
 
 
 def _bits_to_window_digits(bits: np.ndarray) -> np.ndarray:
     """(n, 256) LSB-first bit rows -> (64, n) 4-bit digits, MSB window
-    first (the scan consumes windows high to low)."""
+    first (the scan consumes windows high to low); uint8 out."""
     weights = np.array([1, 2, 4, 8], dtype=np.int32)
     digits = bits.reshape(bits.shape[0], _WINDOWS, _WINDOW_BITS) @ weights
-    return np.ascontiguousarray(digits[:, ::-1].T)
+    return np.ascontiguousarray(digits[:, ::-1].T).astype(np.uint8)
 
 
 def _bits_to_comb_digits8(bits: np.ndarray) -> np.ndarray:
@@ -167,13 +173,14 @@ def _bits_to_comb_digits8(bits: np.ndarray) -> np.ndarray:
     first (the comb sums windows, order-free)."""
     weights = np.array([1, 2, 4, 8, 16, 32, 64, 128], dtype=np.int32)
     digits = bits.reshape(bits.shape[0], 32, 8) @ weights
-    return np.ascontiguousarray(digits.T)
+    return np.ascontiguousarray(digits.T).astype(np.uint8)
 
 
 def to_kernel_layout(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok):
     """Host row-major arrays -> device layout: limbs/digits leading (on the
     sublanes), batch trailing (on the lanes); S as 8-bit comb digits, k as
-    MSB-first 4-bit Horner digits."""
+    MSB-first 4-bit Horner digits.  Everything ships as the narrowest
+    integer dtype (uint8/bool) — the kernel widens on device."""
     return (
         jnp.asarray(np.ascontiguousarray(y_r.T)),
         jnp.asarray(sign_r),

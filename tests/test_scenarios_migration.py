@@ -63,7 +63,7 @@ def test_migrate_to_rotation_and_back():
     leader = None
     for node in cluster.nodes.values():
         lid = node.consensus.get_leader_id()
-        if lid is not None:
+        if lid:  # 0 is the not-running sentinel, never a node id
             leader = lid
             break
     assert leader is not None
