@@ -171,6 +171,27 @@ class EcdsaP256BatchVerifier:
         self._min_device_batch = min_device_batch
         self._pad_to = pad_to
 
+    @staticmethod
+    def _batch_invert_mod_n(values: list[int]) -> list[int]:
+        """Montgomery batch inversion mod the group order: ONE modular
+        exponentiation + 3 multiplications per element, vs one ~25 µs
+        ``pow(s, n-2, n)`` per signature — the dominant host-prep cost at
+        proposal-sized batches.  Zeros pass through as zero (callers have
+        already marked them invalid)."""
+        prefix: list[int] = []
+        acc = 1
+        for v in values:
+            prefix.append(acc)
+            if v:
+                acc = (acc * v) % N
+        inv = pow(acc, N - 2, N)
+        out = [0] * len(values)
+        for i in range(len(values) - 1, -1, -1):
+            if values[i]:
+                out[i] = (inv * prefix[i]) % N
+                inv = (inv * values[i]) % N
+        return out
+
     def _prepare(self, messages, signatures, public_keys):
         n = len(messages)
         host_ok = np.ones(n, dtype=bool)
@@ -181,6 +202,9 @@ class EcdsaP256BatchVerifier:
         r1_rows = np.zeros((n, 32), dtype=np.uint8)
         r2_rows = np.zeros((n, 32), dtype=np.uint8)
         has_r2 = np.zeros(n, dtype=bool)
+        rs = [0] * n
+        ss = [0] * n
+        es = [0] * n
         for i in range(n):
             sig = signatures[i]
             key = public_keys[i]
@@ -197,16 +221,20 @@ class EcdsaP256BatchVerifier:
             if qx >= fp.P or qy >= fp.P:
                 host_ok[i] = False
                 continue
-            e = int.from_bytes(hashlib.sha256(messages[i]).digest(), "big")
-            w = pow(s, N - 2, N)
-            u1s[i] = (e * w) % N
-            u2s[i] = (r * w) % N
+            rs[i], ss[i] = r, s
+            es[i] = int.from_bytes(hashlib.sha256(messages[i]).digest(), "big")
             qx_rows[i] = np.frombuffer(key[1:33], dtype=np.uint8)
             qy_rows[i] = np.frombuffer(key[33:], dtype=np.uint8)
             r1_rows[i] = np.frombuffer(r.to_bytes(32, "big"), dtype=np.uint8)
             if r + N < fp.P:
                 has_r2[i] = True
                 r2_rows[i] = np.frombuffer((r + N).to_bytes(32, "big"), dtype=np.uint8)
+        ws = self._batch_invert_mod_n(ss)
+        for i in range(n):
+            if not ss[i]:
+                continue
+            u1s[i] = (es[i] * ws[i]) % N
+            u2s[i] = (rs[i] * ws[i]) % N
         return (
             _be_bytes_to_limb_rows(qx_rows),
             _be_bytes_to_limb_rows(qy_rows),
