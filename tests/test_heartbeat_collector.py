@@ -148,6 +148,69 @@ def test_non_leader_heartbeats_ignored():
     hm.close()
 
 
+def test_two_monitors_swap_roles_and_only_final_follower_times_out():
+    # Parity model: reference TestHeartbeatMonitorLeaderAndFollower
+    # (heartbeatmonitor_test.go:233) — two monitors exchange roles across
+    # views 10/11/12; after the final leader closes, the surviving follower
+    # times out exactly once, in the final view.
+    s = SimScheduler()
+    hm1, comm1, handler1 = make_monitor(s)
+    hm2, comm2, handler2 = make_monitor(s)
+    # Wire the two monitors' broadcasts to each other.
+    comm1.broadcast = lambda msg: hm2.process_msg(1, msg)
+    comm2.broadcast = lambda msg: hm1.process_msg(2, msg)
+
+    hm1.change_role(Role.LEADER, view=10, leader_id=1)
+    hm2.change_role(Role.FOLLOWER, view=10, leader_id=1)
+    s.advance(20.0)
+    hm1.change_role(Role.FOLLOWER, view=11, leader_id=2)
+    hm2.change_role(Role.LEADER, view=11, leader_id=2)
+    s.advance(20.0)
+    # Healthy exchanges so far: nobody complained.
+    assert handler1.timeouts == [] and handler2.timeouts == []
+
+    # View 12: leader first (avoid a stale-view response), then kill it.
+    hm2.change_role(Role.LEADER, view=12, leader_id=2)
+    hm1.change_role(Role.FOLLOWER, view=12, leader_id=2)
+    hm2.close()
+    s.advance(30.0)
+    assert handler1.timeouts == [(12, 2)]  # exactly once, final view
+    hm1.close()
+
+
+def test_artificial_heartbeat_does_not_count_toward_behind_sync():
+    # The controller converts leader protocol traffic into artificial
+    # heartbeats; those keep the leader alive but must NOT drive the
+    # behind-by-one sync counter (reference heartbeatmonitor.go:216-257
+    # gates on real heartbeats).
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s, view_seq=(True, 0), behind=3)
+    hm.change_role(Role.FOLLOWER, view=1, leader_id=2)
+    for _ in range(10):
+        hm.inject_artificial_heartbeat(2, HeartBeat(view=1, seq=1))
+        s.advance(1.0)
+    assert handler.syncs == 0  # never counted as behind
+    assert handler.timeouts == []  # ...but they DO keep the leader alive
+    # Real heartbeats with seq = ours+1 DO count after `behind` ticks.
+    for _ in range(4):
+        hm.process_msg(2, HeartBeat(view=1, seq=1))
+        s.advance(1.0)
+    assert handler.syncs >= 1
+
+
+def test_leader_below_f_plus_one_responses_does_not_sync():
+    s = SimScheduler()
+    hm, _, handler = make_monitor(s)  # n=4 -> f=1 -> needs 2 senders
+    hm.change_role(Role.LEADER, view=3, leader_id=1)
+    hm.process_msg(2, HeartBeatResponse(view=7))
+    hm.process_msg(2, HeartBeatResponse(view=7))  # same sender twice
+    s.advance(2.0)
+    assert handler.syncs == 0
+    hm.process_msg(3, HeartBeatResponse(view=7))  # second distinct sender
+    s.advance(2.0)
+    assert handler.syncs == 1
+
+
 # --- collector -------------------------------------------------------------
 
 
