@@ -49,7 +49,7 @@ def _bytes_rows_to_bits(rows: np.ndarray) -> np.ndarray:
 
 _WINDOW_BITS = 4
 _WINDOWS = 256 // _WINDOW_BITS  # 64
-_TABLE = 1 << _WINDOW_BITS      # 16
+_TABLE = 9  # signed digits: |d| <= 8 -> multiples 0..8 of (-A)
 
 
 def verify_impl(
@@ -58,7 +58,7 @@ def verify_impl(
     y_a: jnp.ndarray,       # (32, batch) A.y limbs, uint8 on the wire
     sign_a: jnp.ndarray,    # (batch,)    A.x sign bits
     s_digits8: jnp.ndarray, # (32, batch) S 8-bit window digits, LSB window first
-    k_digits: jnp.ndarray,  # (64, batch) k 4-bit window digits, MSB window first
+    k_digits: jnp.ndarray,  # (64, batch) k signed 4-bit digits + 8, MSB window first
     host_ok: jnp.ndarray,   # (batch,)    host-side pre-checks passed
 ) -> jnp.ndarray:
     """Un-jitted kernel body — every op is independent per batch element
@@ -66,8 +66,9 @@ def verify_impl(
     shards over the batch axis unchanged (see :mod:`consensus_tpu.parallel`).
 
     acc = [S]B + [k](-A) is split by operand class: the variable half
-    [k](-A) runs a 4-bit-windowed Horner scan (64 steps of 4 doubles + 1
-    table add; j*(-A) built per batch with 14 additions), while the
+    [k](-A) runs a signed-4-bit-windowed Horner scan (64 steps of 4
+    doubles + 1 table add; j*(-A) for j <= 8 built per batch with 7
+    additions, sign applied by a mul-free conditional negate), while the
     fixed-base half [S]B — B is a compile-time constant — uses an 8-bit
     comb over precomputed tables (:func:`consensus_tpu.ops.ed25519
     .fixed_base_mul_comb`): 32 constant lookups + mixed adds, zero doubles,
@@ -105,17 +106,20 @@ def verify_impl(
     # carry type-checks under shard_map.
     a_table = ed.multiples_table(neg_a, _TABLE)
 
-    lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]  # (16, 1)
+    lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]  # (9, 1)
 
-    def step(acc: ed.Point, k_d):
-        k_oh = (k_d[None] == lanes).astype(jnp.float32)  # (16, batch)
+    def step(acc: ed.Point, k_w):
+        d = k_w - 8                 # signed digit in [-8, 7]
+        k_oh = (jnp.abs(d)[None] == lanes).astype(jnp.float32)  # (9, batch)
         # 3 T-free doubles as an inner scan (one body in the graph) + the
         # final T-producing double — graph size, not runtime, economy.
         acc, _ = jax.lax.scan(
             lambda a, _: (ed.double(a, need_t=False), None), acc, None, length=3
         )
         acc = ed.double(acc)
-        acc = ed.add(acc, ed.table_lookup(a_table, k_oh))
+        q = ed.table_lookup(a_table, k_oh)
+        q = ed.select(d < 0, ed.negate(q), q)  # two field subs, no muls
+        acc = ed.add(acc, q)
         return acc, None
 
     acc, _ = jax.lax.scan(step, ed.identity_like(y_r), k_digits)
@@ -160,12 +164,27 @@ def _prep_compressed(points: Sequence[bytes]) -> tuple[np.ndarray, np.ndarray, n
     return rows, signs, ok  # byte-sized limbs: the bytes ARE the limbs
 
 
-def _bits_to_window_digits(bits: np.ndarray) -> np.ndarray:
-    """(n, 256) LSB-first bit rows -> (64, n) 4-bit digits, MSB window
-    first (the scan consumes windows high to low); uint8 out."""
+def _bits_to_signed_window_digits(bits: np.ndarray) -> np.ndarray:
+    """(n, 256) LSB-first bit rows -> (64, n) SIGNED 4-bit digits in
+    [-8, 7], wire-encoded as d+8 (uint8), MSB window first.
+
+    Signed digits halve the scan's per-batch table: |d| <= 8 needs 9
+    multiples of (-A) instead of 16 (negation is two mul-free field subs
+    on device).  The LSB-to-MSB carry cannot escape: k < L < 2^253, so
+    the top window is at most 1 before carry — no 65th window ever
+    needed."""
     weights = np.array([1, 2, 4, 8], dtype=np.int32)
-    digits = bits.reshape(bits.shape[0], _WINDOWS, _WINDOW_BITS) @ weights
-    return np.ascontiguousarray(digits[:, ::-1].T).astype(np.uint8)
+    u = bits.reshape(bits.shape[0], _WINDOWS, _WINDOW_BITS) @ weights  # (n, 64)
+    d = np.zeros_like(u)
+    carry = np.zeros(u.shape[0], dtype=u.dtype)
+    for j in range(_WINDOWS):
+        t = u[:, j] + carry
+        over = t >= 8
+        d[:, j] = np.where(over, t - 16, t)
+        carry = over.astype(u.dtype)
+    if carry.any():  # unreachable for canonical k (< 2^253)
+        raise ValueError("scalar overflow in signed-digit recoding")
+    return np.ascontiguousarray(d[:, ::-1].T + 8).astype(np.uint8)
 
 
 def _bits_to_comb_digits8(bits: np.ndarray) -> np.ndarray:
@@ -187,7 +206,7 @@ def to_kernel_layout(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok):
         jnp.asarray(np.ascontiguousarray(y_a.T)),
         jnp.asarray(sign_a),
         jnp.asarray(_bits_to_comb_digits8(s_bits)),
-        jnp.asarray(_bits_to_window_digits(k_bits)),
+        jnp.asarray(_bits_to_signed_window_digits(k_bits)),
         jnp.asarray(host_ok),
     )
 
