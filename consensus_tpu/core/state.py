@@ -6,6 +6,7 @@ Parity: reference internal/bft/state.go:31-247 (PersistedState), util.go:191-254
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 from typing import Callable, Optional, Sequence
 
@@ -28,6 +29,23 @@ from consensus_tpu.wire import (
 )
 
 logger = logging.getLogger("consensus_tpu.state")
+
+
+def restore_requests_best_effort(view: "View", proposal: Proposal) -> None:
+    """Populate ``view.in_flight_requests`` from the application's
+    ``requests_from_proposal`` during phase re-entry, so a restored replica
+    that goes on to commit still removes the batch from its pool and counts
+    it in the tx metrics.  Best-effort: a restored view with an empty
+    request list commits correctly; only that cleanup/accounting is lost."""
+    try:
+        view.in_flight_requests = tuple(
+            view._verifier.requests_from_proposal(proposal)
+        )
+    except Exception:
+        logger.exception(
+            "requests_from_proposal failed during restore; "
+            "continuing with an empty request list"
+        )
 
 
 class InFlightData:
@@ -172,6 +190,21 @@ class PersistedState:
         self._enter_proposed(record, view)
         logger.info("restored into PROPOSED at seq %d", pp.seq)
 
+    def mark_proposed_verified(self, view_number: int, seq: int) -> None:
+        """Flip the in-memory ProposedRecord to verified once the (leader's)
+        deferred verification succeeds, so a mid-run view restart
+        (reseed_if_inflight_matches) does not re-verify a proposal this
+        process already verified.  The on-disk record is left as written —
+        a crash-restore re-verifies, the conservative side."""
+        rec = self._mem_proposed
+        if (
+            rec is not None
+            and not rec.verified
+            and rec.pre_prepare.view == view_number
+            and rec.pre_prepare.seq == seq
+        ):
+            self._mem_proposed = dataclasses.replace(rec, verified=True)
+
     def _enter_proposed(self, record: ProposedRecord, view: View) -> None:
         """Shared phase-reentry: seed ``view`` into PROPOSED from a
         persisted pre-prepare (used by boot restore AND the mid-run
@@ -182,6 +215,31 @@ class PersistedState:
         md = decode_view_metadata(pp.proposal.metadata)
         view.decisions_in_view = md.decisions_in_view
         view.phase = Phase.PROPOSED
+        if not record.verified:
+            # The record was persisted BEFORE its verification completed —
+            # only the leader's own reveal-before-verify path writes such
+            # records (view.py::_try_process_proposal).  Durability does not
+            # imply verification here, so re-run it before re-arming the
+            # prepare: the prepare is an endorsement and must never outlive
+            # a failed verification via restore.  On failure we stay pinned
+            # to the proposal (no equivocation) but never endorse it — the
+            # prepare stays un-armed AND the PREPARED transition (commit
+            # signing) is blocked; the complaint cascade deposes us.
+            try:
+                requests = view._verify_proposal(
+                    pp.proposal, pp.prev_commit_signatures
+                )
+            except Exception as err:
+                logger.warning(
+                    "restored own proposal at (%d, %d) fails verification "
+                    "(%s); staying pinned without endorsing it",
+                    pp.view, pp.seq, err,
+                )
+                view.endorsement_blocked = True
+                return
+            view.in_flight_requests = tuple(requests)
+        else:
+            restore_requests_best_effort(view, pp.proposal)
         p = record.prepare
         view._curr_prepare_sent = Prepare(
             view=p.view, seq=p.seq, digest=p.digest, assist=True
@@ -194,6 +252,7 @@ class PersistedState:
         self._in_flight.store_proposal(pp.proposal)
         self._in_flight.store_prepared(commit.view, commit.seq)
         view.in_flight_proposal = pp.proposal
+        restore_requests_best_effort(view, pp.proposal)
         md = decode_view_metadata(pp.proposal.metadata)
         view.decisions_in_view = md.decisions_in_view
         view.my_commit_signature = commit.signature

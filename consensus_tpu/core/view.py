@@ -98,7 +98,9 @@ class ViewComm(Protocol):
 class ViewState(Protocol):
     """WAL persistence seam (PersistedState implements it)."""
 
-    def save(self, record) -> None: ...
+    def save(self, record, on_durable=None) -> None: ...
+
+    def mark_proposed_verified(self, view_number: int, seq: int) -> None: ...
 
 
 class CheckpointReader(Protocol):
@@ -181,6 +183,12 @@ class View:
         self._last_voted_proposal_by_id: dict[int, Commit] = {}
 
         self.stopped = False
+        #: Set when a restore re-verification of our own proposal failed
+        #: (state.py::_enter_proposed): we stay pinned to the proposal (no
+        #: equivocation) but must never endorse it — no prepare was armed,
+        #: and the PROPOSED->PREPARED transition (which signs a commit, a
+        #: stronger endorsement) is blocked until a view change resolves it.
+        self.endorsement_blocked = False
         self._begin_pre_prepare = 0.0
         self.metrics = metrics or MetricsView(NoopProvider())
         self.metrics.view_number.set(number)
@@ -340,6 +348,68 @@ class View:
         _, pp = self._pending_pre_prepare
         self._pending_pre_prepare = None
         proposal = pp.proposal
+        i_am_leader = self.self_id == self.leader_id
+
+        prepare = Prepare(
+            view=self.number, seq=self.proposal_sequence, digest=proposal.digest()
+        )
+        # The prepare may only go out once BOTH gates pass: the ProposedRecord
+        # is durable (WAL-before-send, view.go:404-414) and the proposal is
+        # verified.  All callbacks run on the replica's scheduler thread
+        # (group-commit flushes are scheduler events), so the gates need no
+        # lock; _curr_prepare_sent doubles as the sent-once guard (it is
+        # reset by _start_next_seq).
+        gate = {"durable": False, "verified": False}
+
+        def maybe_send_prepare() -> None:
+            if self.stopped or not (gate["durable"] and gate["verified"]):
+                return
+            if self.proposal_sequence != prepare.seq:
+                return  # stale callback from a bygone sequence
+            if self._curr_prepare_sent is not None:
+                return
+            # The assist copy is only armed here — retransmission help must
+            # never reveal an un-persisted message either.
+            self._curr_prepare_sent = Prepare(
+                view=prepare.view, seq=prepare.seq, digest=prepare.digest, assist=True
+            )
+            self._comm.broadcast(prepare)
+
+        def send_after_durable() -> None:
+            # Under group commit this fires from the batched fsync event;
+            # default mode fires inline during save().  Idempotent: a retried
+            # flush must not re-reveal the pre-prepare, and a callback that a
+            # failed fsync delayed past its own sequence must not fire at all.
+            if self.stopped or gate["durable"]:
+                return
+            if self.proposal_sequence != prepare.seq:
+                return
+            if i_am_leader:
+                # Reveal the proposal the moment it is durable — BEFORE our
+                # own verification completes.  This departs from the
+                # reference's ordering (view.go:421-423 echoes the
+                # pre-prepare only after verifyProposal) deliberately: the
+                # followers' proposal verification then overlaps the
+                # leader's, and on the batch-verify engine all n replicas'
+                # request sweeps coalesce into ONE device launch instead of
+                # the leader's solo launch serializing before everyone
+                # else's.  Safety is unaffected: a pre-prepare carries no
+                # endorsement (prepares/commits do, and ours still waits for
+                # verification), and the durable ProposedRecord already
+                # pins us to this proposal at this (view, seq) across
+                # crashes, so no equivocation window opens.
+                self._comm.broadcast(pp)
+            gate["durable"] = True
+            maybe_send_prepare()
+
+        if i_am_leader:
+            # verified=False: this record is written BEFORE our own
+            # verification completes, and any restore from it must re-verify
+            # (state.py::_enter_proposed) before re-arming the prepare.
+            self._state.save(
+                ProposedRecord(pre_prepare=pp, prepare=prepare, verified=False),
+                on_durable=send_after_durable,
+            )
 
         try:
             requests = self._verify_proposal(proposal, pp.prev_commit_signatures)
@@ -352,42 +422,38 @@ class View:
             self.abort()
             return
 
-        prepare = Prepare(
-            view=self.number, seq=self.proposal_sequence, digest=proposal.digest()
-        )
-
-        def send_after_durable() -> None:
-            # WAL before send: we must remember having prepared before
-            # anyone hears about it (view.go:404-414).  Under group commit
-            # this fires from the batched fsync; default mode fires inline.
-            # The assist copy is also only armed here — retransmission help
-            # must never reveal an un-persisted message either.
-            if self.stopped:
-                return
-            self._curr_prepare_sent = Prepare(
-                view=prepare.view, seq=prepare.seq, digest=prepare.digest, assist=True
-            )
-            if self.self_id == self.leader_id:
-                # Only now does the leader reveal the proposal to the others.
-                self._comm.broadcast(pp)
-            self._comm.broadcast(prepare)
-
         self.in_flight_proposal = proposal
         self.in_flight_requests = tuple(requests)
         self.metrics.count_txs_in_batch.set(len(requests))
+        # Stamped post-verification on every replica, keeping
+        # latency_batch_processing's definition (prepare/commit exchange
+        # only) identical to the pre-reordering numbers in BASELINE.md.
         self._begin_pre_prepare = self._sched.now()
         self.phase = Phase.PROPOSED
         self.metrics.phase.set(int(self.phase))
-        self._state.save(
-            ProposedRecord(pre_prepare=pp, prepare=prepare),
-            on_durable=send_after_durable,
-        )
+        if i_am_leader:
+            # Verification succeeded: flip the in-memory record so a mid-run
+            # view restart (reseed_if_inflight_matches) does not pay a
+            # redundant re-verify.  The on-disk record keeps verified=False —
+            # a crash-restore re-verifies, which is the conservative side.
+            self._state.mark_proposed_verified(self.number, prepare.seq)
+        else:
+            # Followers keep the reference's strict order: verify first,
+            # then persist, then speak (view.go:351-427).
+            self._state.save(
+                ProposedRecord(pre_prepare=pp, prepare=prepare),
+                on_durable=send_after_durable,
+            )
+        gate["verified"] = True
+        maybe_send_prepare()
         logger.info("%d: proposed seq %d in view %d", self.self_id, prepare.seq, self.number)
 
     # --- PROPOSED -> PREPARED (view.go:441-517) ----------------------------
 
     def _try_process_prepares(self) -> None:
         assert self.in_flight_proposal is not None
+        if self.endorsement_blocked:
+            return
         expected = self.in_flight_proposal.digest()
         voters = [s for s, p in self._prepares.items() if p.digest == expected]
         if len(voters) < self.quorum - 1:
@@ -405,7 +471,7 @@ class View:
         )
 
         def send_after_durable() -> None:
-            if self.stopped:
+            if self.stopped or self.proposal_sequence != commit.seq:
                 return
             self._curr_commit_sent = Commit(
                 view=commit.view,

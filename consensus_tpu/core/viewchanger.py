@@ -37,7 +37,11 @@ import logging
 from typing import Callable, Optional, Protocol, Sequence
 
 from consensus_tpu.api.deps import Signer, Verifier
-from consensus_tpu.core.state import InFlightData, PersistedState
+from consensus_tpu.core.state import (
+    InFlightData,
+    PersistedState,
+    restore_requests_best_effort,
+)
 from consensus_tpu.core.view import Phase, View
 from consensus_tpu.metrics import MetricsViewChange, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
@@ -947,7 +951,10 @@ class ViewChanger:
         )
         view.phase = Phase.PREPARED
         view.in_flight_proposal = proposal
-        view.in_flight_requests = tuple(self._verifier.requests_from_proposal(proposal))
+        # Best-effort, shared with the WAL restore paths: an application
+        # exception here must not stall the view change (the requests only
+        # feed pool cleanup at decide time).
+        restore_requests_best_effort(view, proposal)
         view.my_commit_signature = self._signer.sign_proposal(proposal, b"")
         commit = Commit(
             view=view.number,

@@ -452,19 +452,23 @@ def decode_view_metadata(buf: bytes) -> ViewMetadata:
 def _w_proposed_record(w: _Writer, m: ProposedRecord) -> None:
     _w_pre_prepare(w, m.pre_prepare)
     _w_prepare(w, m.prepare)
+    w.boolean(m.verified)
 
 
-def _r_proposed_record(r: _Reader) -> ProposedRecord:
+def _r_proposed_record(r: _Reader, version: int) -> ProposedRecord:
     pp = _r_pre_prepare(r)
     p = _r_prepare(r)
-    return ProposedRecord(pre_prepare=pp, prepare=p)
+    # v1 records predate the flag; they were only ever written after
+    # verification succeeded (the strict verify-then-persist order).
+    verified = r.boolean() if version >= 2 else True
+    return ProposedRecord(pre_prepare=pp, prepare=p, verified=verified)
 
 
 def _w_saved_commit(w: _Writer, m: SavedCommit) -> None:
     _w_commit(w, m.commit)
 
 
-def _r_saved_commit(r: _Reader) -> SavedCommit:
+def _r_saved_commit(r: _Reader, version: int) -> SavedCommit:
     return SavedCommit(commit=_r_commit(r))
 
 
@@ -472,7 +476,7 @@ def _w_saved_new_view(w: _Writer, m: SavedNewView) -> None:
     _w_view_metadata(w, m.view_metadata)
 
 
-def _r_saved_new_view(r: _Reader) -> SavedNewView:
+def _r_saved_new_view(r: _Reader, version: int) -> SavedNewView:
     return SavedNewView(view_metadata=_r_view_metadata(r))
 
 
@@ -480,11 +484,16 @@ def _w_saved_view_change(w: _Writer, m: SavedViewChange) -> None:
     _w_view_change(w, m.view_change)
 
 
-def _r_saved_view_change(r: _Reader) -> SavedViewChange:
+def _r_saved_view_change(r: _Reader, version: int) -> SavedViewChange:
     return SavedViewChange(view_change=_r_view_change(r))
 
 
 # Tags mirror the SavedMessage oneof (smartbftprotos/messages.proto:113-120).
+# Readers take (reader, envelope_version) — the WAL-record domain is
+# versioned independently of the wire messages so a record-layout change
+# cannot invalidate inter-replica traffic (and vice versa).
+_SAVED_VERSION = 2  # v2: ProposedRecord gained `verified` (v1 record => True)
+
 _SAVED_CODECS: dict[int, tuple[type, Callable, Callable]] = {
     1: (ProposedRecord, _w_proposed_record, _r_proposed_record),
     2: (SavedCommit, _w_saved_commit, _r_saved_commit),
@@ -501,7 +510,7 @@ def encode_saved(msg: SavedMessage) -> bytes:
     if tag is None:
         raise CodecError(f"not a saved message: {type(msg).__name__}")
     w = _Writer()
-    w.u8(_VERSION)
+    w.u8(_SAVED_VERSION)
     w.u8(_DOMAIN_SAVED)
     w.u8(tag)
     _SAVED_CODECS[tag][1](w, msg)
@@ -509,10 +518,12 @@ def encode_saved(msg: SavedMessage) -> bytes:
 
 
 def decode_saved(buf: bytes) -> SavedMessage:
-    """Parse bytes produced by :func:`encode_saved`."""
+    """Parse bytes produced by :func:`encode_saved` (any accepted version —
+    a WAL written before an upgrade must keep restoring, or the crash-
+    recovery pin it carries is silently lost)."""
     r = _Reader(buf)
     version = r.u8()
-    if version != _VERSION:
+    if not 1 <= version <= _SAVED_VERSION:
         raise CodecError(f"unsupported codec version {version}")
     if r.u8() != _DOMAIN_SAVED:
         raise CodecError("not a WAL-record encoding (wrong domain byte)")
@@ -520,7 +531,7 @@ def decode_saved(buf: bytes) -> SavedMessage:
     entry = _SAVED_CODECS.get(tag)
     if entry is None:
         raise CodecError(f"unknown saved-message tag {tag}")
-    msg = entry[2](r)
+    msg = entry[2](r, version)
     r.expect_end()
     return msg
 

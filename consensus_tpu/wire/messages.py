@@ -197,11 +197,21 @@ ConsensusMessage = Union[
 class ProposedRecord:
     """WAL record: a proposal was accepted and a prepare is about to be sent.
 
-    Parity: reference smartbftprotos/messages.proto:43-46.
+    ``verified`` records whether proposal verification had already succeeded
+    when the record was written.  Followers verify before persisting, so
+    their records say True; the leader persists (and reveals) its own
+    proposal BEFORE verifying it (reveal-before-verify,
+    core/view.py::_try_process_proposal), so its record says False until
+    verification completes — and any restore from a False record must
+    re-verify before re-arming the prepare endorsement.
+
+    Parity: reference smartbftprotos/messages.proto:43-46 (the flag is an
+    addition; the reference has no pre-verification persistence).
     """
 
     pre_prepare: PrePrepare
     prepare: Prepare
+    verified: bool = True
 
 
 @dataclass(frozen=True)

@@ -5,6 +5,8 @@ Parity model: reference internal/bft/state_test.go + test/basic_test.go
 restart scenarios (e.g. TestRestartFollower).
 """
 
+import dataclasses
+
 from consensus_tpu.core.state import InFlightData, PersistedState
 from consensus_tpu.core.view import Phase
 from consensus_tpu.testing import Cluster, MemWAL, make_request
@@ -27,15 +29,34 @@ from consensus_tpu.wire import (
 class ViewStub:
     """Just the fields PersistedState.restore touches."""
 
-    def __init__(self, proposal_sequence=0):
+    class _Verifier:
+        def requests_from_proposal(self, proposal):
+            return []
+
+    def __init__(self, proposal_sequence=0, self_id=2, leader_id=1, number=0):
         self.phase = None
-        self.number = 0
+        self.number = number
         self.proposal_sequence = proposal_sequence
         self.decisions_in_view = 0
         self.in_flight_proposal = None
+        self.in_flight_requests = ()
         self.my_commit_signature = None
         self._curr_prepare_sent = None
         self._curr_commit_sent = None
+        self.self_id = self_id
+        self.leader_id = leader_id
+        self.endorsement_blocked = False
+        self.reverify_calls = []
+        self._verifier = self._Verifier()
+
+    def _verify_proposal(self, proposal, prev_commits):
+        # Only consulted when restoring a record persisted BEFORE its
+        # verification completed (verified=False — the leader's
+        # reveal-before-verify path).
+        self.reverify_calls.append((proposal, tuple(prev_commits)))
+        if proposal.payload.startswith(b"BAD"):
+            raise ValueError("rejected on restore")
+        return []
 
 
 def proposal_at(view, seq, decisions=0):
@@ -205,3 +226,89 @@ def test_restart_during_view_change_rejoins_it():
     )
     cluster.assert_ledgers_consistent()
     assert cluster.nodes[4].consensus.controller.curr_view_number >= 1
+
+
+def test_restore_unverified_record_reverifies_before_arming_prepare():
+    """A ProposedRecord with verified=False (the leader's reveal-before-
+    verify path persists before verification completes,
+    view.py::_try_process_proposal) must be re-verified on restore before
+    the prepare endorsement is re-armed.  The flag — not the restored
+    view's leader identity, which can differ from pp.view's after a
+    truncated view change — is the discriminator."""
+    wal = MemWAL([])
+    record = dataclasses.replace(proposed_record(view=2, seq=5), verified=False)
+    wal.append(encode_saved(record), truncate_to=True)
+    state = PersistedState(wal, InFlightData(), entries=wal.entries)
+    v = ViewStub(self_id=1, leader_id=1)
+    state.restore(v)
+    assert v.reverify_calls  # re-verified the unverified record
+    assert v.phase == Phase.PROPOSED
+    assert v._curr_prepare_sent is not None
+    assert not v.endorsement_blocked
+
+
+def test_restore_unverified_bad_proposal_stays_pinned_but_never_endorses():
+    wal = MemWAL([])
+    md = ViewMetadata(view_id=2, latest_sequence=5, decisions_in_view=0)
+    prop = Proposal(payload=b"BAD", metadata=encode_view_metadata(md))
+    pp = PrePrepare(view=2, seq=5, proposal=prop)
+    record = ProposedRecord(
+        pre_prepare=pp,
+        prepare=Prepare(view=2, seq=5, digest=prop.digest()),
+        verified=False,
+    )
+    wal.append(encode_saved(record), truncate_to=True)
+    state = PersistedState(wal, InFlightData(), entries=wal.entries)
+    v = ViewStub(self_id=1, leader_id=1)
+    state.restore(v)
+    # Pinned to the proposal (no equivocation) but the prepare is NOT armed
+    # and the PREPARED transition is blocked: prepares and commits are
+    # endorsements and the record never implied verification.
+    assert v.in_flight_proposal == prop
+    assert v.phase == Phase.PROPOSED
+    assert v._curr_prepare_sent is None
+    assert v.endorsement_blocked
+
+
+def test_restore_verified_record_does_not_reverify():
+    """A verified=True record was only ever written after verification
+    succeeded — restore must NOT re-verify (a reconfiguration could have
+    bumped the verification sequence and false-fail a legitimate record),
+    regardless of whether we were the leader of that view."""
+    wal = MemWAL([])
+    record = proposed_record(view=2, seq=5)  # verified=True default
+    assert record.verified
+    wal.append(encode_saved(record), truncate_to=True)
+    state = PersistedState(wal, InFlightData(), entries=wal.entries)
+    v = ViewStub(self_id=1, leader_id=1)  # even as the view's own leader
+    state.restore(v)
+    assert v.reverify_calls == []
+    assert v._curr_prepare_sent is not None
+
+
+def test_mark_proposed_verified_flips_memory_record_only():
+    """After the leader's deferred verification succeeds, the in-memory
+    record flips to verified (so a mid-run reseed skips the re-verify) but
+    the on-disk record keeps verified=False (crash-restore re-verifies)."""
+    from consensus_tpu.wire import decode_saved
+
+    wal = MemWAL([])
+    record = dataclasses.replace(proposed_record(view=2, seq=5), verified=False)
+    state = PersistedState(wal, InFlightData(), entries=wal.entries)
+    state.save(record)
+    state.mark_proposed_verified(2, 5)
+
+    v = ViewStub(number=2, proposal_sequence=5)
+    state.reseed_if_inflight_matches(v)
+    assert v.reverify_calls == []  # memory copy is verified: no re-verify
+    assert v._curr_prepare_sent is not None
+    disk = decode_saved(wal.entries[-1])
+    assert not disk.verified  # the durable record is untouched
+
+    # A non-matching (view, seq) must not flip anything.
+    state2 = PersistedState(MemWAL([]), InFlightData(), entries=[])
+    state2.save(dataclasses.replace(proposed_record(view=3, seq=9), verified=False))
+    state2.mark_proposed_verified(3, 8)
+    v2 = ViewStub(number=3, proposal_sequence=9)
+    state2.reseed_if_inflight_matches(v2)
+    assert v2.reverify_calls  # still unverified: reseed re-verifies

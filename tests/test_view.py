@@ -92,11 +92,15 @@ class FakeComm:
 class FakeState:
     def __init__(self):
         self.saved = []
+        self.marked_verified = []
 
     def save(self, record, on_durable=None):
         self.saved.append(record)
         if on_durable is not None:
             on_durable()  # per-append fsync semantics
+
+    def mark_proposed_verified(self, view_number, seq):
+        self.marked_verified.append((view_number, seq))
 
 
 class FakeDecider:
@@ -558,3 +562,68 @@ class TestAdversarialInputs:
         )
         assert h.decider.decisions == []
         assert h.view.proposal_sequence == 0
+
+
+def test_leader_reveals_pre_prepare_before_own_verification():
+    """The leader broadcasts the pre-prepare as soon as the ProposedRecord
+    is durable and BEFORE its own verification completes (deliberate
+    deviation from reference view.go:421-423, documented in
+    _try_process_proposal): the followers' batch verifies then overlap the
+    leader's, coalescing into one device launch per proposal wave.  The
+    prepare must still wait for verification."""
+    h = Harness(self_id=1, leader_id=1)
+    seen = []
+
+    orig_verify = h.verifier.verify_proposal
+
+    def recording_verify(proposal):
+        seen.append(
+            (
+                [type(m).__name__ for m in h.comm.broadcasts],
+                [type(r).__name__ for r in h.state.saved],
+            )
+        )
+        return orig_verify(proposal)
+
+    h.verifier.verify_proposal = recording_verify
+    h.view.propose(h.make_proposal())
+
+    # At verify time: record persisted and pre-prepare revealed, prepare out
+    # only afterwards.
+    assert seen == [(["PrePrepare"], ["ProposedRecord"])]
+    assert [type(m).__name__ for m in h.comm.broadcasts] == ["PrePrepare", "Prepare"]
+
+
+def test_leader_prepare_waits_for_deferred_durability():
+    """Group-commit WAL model: on_durable fires later.  Neither the reveal
+    nor the prepare may precede durability, and the prepare must fire
+    exactly once when both gates (durable, verified) have passed."""
+    h = Harness(self_id=1, leader_id=1)
+    pending = []
+    h.state.save = lambda record, on_durable=None: (
+        h.state.saved.append(record),
+        pending.append(on_durable),
+    )
+    h.view.propose(h.make_proposal())
+    # Verification already completed (synchronous), durability has not.
+    assert h.view.phase == Phase.PROPOSED
+    assert h.comm.broadcasts == []
+    (cb,) = pending
+    cb()
+    kinds = [type(m).__name__ for m in h.comm.broadcasts]
+    assert kinds == ["PrePrepare", "Prepare"]
+    cb()  # a duplicate durability callback must not double-send
+    assert len(h.comm.broadcasts) == 2
+
+
+def test_leader_own_bad_proposal_reveals_but_never_prepares():
+    """If the leader's own proposal fails verification after the early
+    reveal, the pre-prepare is already out (harmless: it carries no
+    endorsement) but no prepare follows; the leader complains and aborts
+    like any replica facing a bad proposal."""
+    h = Harness(self_id=1, leader_id=1)
+    bad = Proposal(payload=b"BAD", metadata=h.view.get_metadata())
+    h.view.propose(bad)
+    assert [type(m).__name__ for m in h.comm.broadcasts] == ["PrePrepare"]
+    assert h.fd.complaints == [(0, False)]
+    assert h.view.phase == Phase.ABORT

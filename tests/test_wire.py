@@ -213,3 +213,28 @@ def test_generated_preprepare_roundtrip(
         prev_commit_signatures=(Signature(id=sig_id, value=sig_value, msg=aux),),
     )
     assert decode_message(encode_message(msg)) == msg
+
+
+def test_saved_round_trip_unverified_record():
+    rec = ProposedRecord(
+        pre_prepare=PrePrepare(view=1, seq=2, proposal=PROPOSAL),
+        prepare=Prepare(view=1, seq=2, digest=PROPOSAL.digest()),
+        verified=False,
+    )
+    out = wire.decode_saved(wire.encode_saved(rec))
+    assert out == rec and out.verified is False
+
+
+def test_saved_v1_proposed_record_decodes_as_verified():
+    """A version-1 ProposedRecord (written before the `verified` flag
+    existed) has no trailing boolean; it was only ever persisted after
+    verification succeeded, so decoding must yield verified=True."""
+    rec = ProposedRecord(
+        pre_prepare=PrePrepare(view=1, seq=2, proposal=PROPOSAL),
+        prepare=Prepare(view=1, seq=2, digest=PROPOSAL.digest()),
+    )
+    buf = wire.encode_saved(rec)
+    assert buf[0] == 2  # current saved-domain version
+    v1 = bytes([1]) + buf[1:-1]  # version byte 1, trailing verified byte gone
+    out = wire.decode_saved(v1)
+    assert out == rec and out.verified is True
