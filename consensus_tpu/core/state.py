@@ -238,6 +238,9 @@ class PersistedState:
                 view.endorsement_blocked = True
                 return
             view.in_flight_requests = tuple(requests)
+            # Re-verification succeeded: flip the in-memory copy so later
+            # mid-run reseeds at this (view, seq) don't verify a third time.
+            self.mark_proposed_verified(pp.view, pp.seq)
         else:
             restore_requests_best_effort(view, pp.proposal)
         p = record.prepare

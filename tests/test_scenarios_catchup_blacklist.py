@@ -1,0 +1,148 @@
+"""Scenario matrix: catch-up concurrent with view changes, stale-sync
+fetch-state recovery, and blacklist redemption.
+
+Parity model (reference test/basic_test.go):
+TestCatchingUpWithViewChange:567, TestFetchStateWhenSyncReturnsPrevView:2742,
+TestBlacklistAndRedemption:1978.
+
+Every scenario asserts no-fork safety plus post-heal liveness.
+"""
+
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.wire import decode_view_metadata
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+
+def test_catching_up_while_view_change_runs():
+    """A node that missed a decision rejoins at the same moment the leader
+    is partitioned away: its catch-up (sync of block 1) and the cluster's
+    view change run concurrently, and both must land — the laggard ends up
+    with every block, the new view orders the next request, no fork.
+    Parity: basic_test.go:567 (TestCatchingUpWithViewChange)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+
+    # Node 4 misses the first decision entirely.
+    cluster.network.partition([4])
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, node_ids=[1, 2, 3], max_time=300.0)
+
+    # Swap the partition: node 4 heals exactly as leader 1 drops out.
+    cluster.network.heal()
+    cluster.network.partition([1])
+
+    # New requests reach only 2, 3, 4 — the view change (complaint
+    # cascade) and node 4's catch-up must interleave without stalling.
+    for node_id in (2, 3, 4):
+        cluster.nodes[node_id].submit(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=900.0), (
+        "catch-up + view change did not converge"
+    )
+    assert [d.proposal for d in cluster.nodes[4].app.ledger[:1]] == [
+        d.proposal for d in cluster.nodes[2].app.ledger[:1]
+    ], "laggard caught up with a different block 1"
+    cluster.assert_ledgers_consistent()
+
+
+def test_stale_sync_resolved_by_fetching_cluster_state():
+    """A deposed ex-leader rejoins after TWO view changes that decided
+    nothing new: its Synchronizer has nothing to add (the ledger is already
+    current), so only the fetch-state exchange (StateTransferRequest →
+    f+1 equal (view, seq) votes) can teach it the cluster's current view.
+    It must adopt that view and participate in ordering again.
+    Parity: basic_test.go:2742 (TestFetchStateWhenSyncReturnsPrevView);
+    fetch-state: reference controller.go:707-716, statecollector.go:77-130."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+
+    # One decision in view 0 so every ledger is non-empty and current.
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    # Depose leader 1 (view 0 -> 1, leader 2 takes over)...
+    cluster.network.partition([1])
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=900.0)
+
+    # ...then heal 1 and depose leader 2 as well (view 1 -> 2, leader 3).
+    # Node 1 rejoins behind on BOTH axes — one ledger entry (block 2) and
+    # two views — so its recovery needs sync for the block and fetch-state
+    # for the view.
+    cluster.network.heal()
+    cluster.network.partition([2])
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, node_ids=[1, 3, 4], max_time=900.0), (
+        "node 1 did not catch up (ledger) and adopt the cluster view"
+    )
+
+    # Node 1 now holds every decision; its view knowledge must allow it to
+    # keep participating after node 2 heals too.
+    cluster.network.heal()
+    cluster.submit_to_all(make_request("c", 3))
+    assert cluster.run_until_ledger(4, max_time=900.0)
+    cluster.assert_ledgers_consistent()
+
+
+def _latest_blacklist(node):
+    md = decode_view_metadata(node.app.ledger[-1].proposal.metadata)
+    return list(md.black_list)
+
+
+def test_blacklist_redemption_restores_rotation_through_healed_node():
+    """Rotation + blacklisting: a partitioned leader lands on the
+    blacklist (decisions' metadata carries it); after it heals and keeps
+    prepping decisions, >f observers vouch for it and the deterministic
+    update REDEEMS it — later decisions carry an empty blacklist and
+    rotation flows through the healed node again.
+    Parity: basic_test.go:1978 (TestBlacklistAndRedemption);
+    redemption rule: reference util.go:436-497."""
+    n = 7
+    cluster = Cluster(
+        n,
+        config_tweaks=dict(FAST, decisions_per_leader=1),
+        leader_rotation=True,
+    )
+    cluster.start()
+
+    # Leader 1 is partitioned before anything is ordered: the ensuing view
+    # change (with rotation active) blacklists it.
+    cluster.network.partition([1])
+    healthy = [i for i in range(2, n + 1)]
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, node_ids=healthy, max_time=900.0)
+    assert 1 in _latest_blacklist(cluster.nodes[2]), (
+        "partitioned ex-leader did not land on the blacklist"
+    )
+
+    # Heal node 1.  It catches up and its prepares start being observed;
+    # within a handful of decisions the blacklist update must redeem it.
+    cluster.network.heal()
+    blocks = 1
+    for i in range(1, 10):
+        cluster.submit_to_all(make_request("c", i))
+        blocks += 1
+        assert cluster.run_until_ledger(blocks, max_time=900.0), (
+            f"rotation stalled at block {blocks} after heal"
+        )
+        if not _latest_blacklist(cluster.nodes[2]):
+            break
+    assert not _latest_blacklist(cluster.nodes[2]), (
+        "healed node was never redeemed from the blacklist"
+    )
+
+    # Liveness through a full rotation cycle INCLUDING node 1's turns.
+    for i in range(10, 10 + n):
+        cluster.submit_to_all(make_request("c", i))
+        blocks += 1
+        assert cluster.run_until_ledger(blocks, max_time=900.0), (
+            f"rotation through the redeemed node stalled at block {blocks}"
+        )
+    cluster.assert_ledgers_consistent()
