@@ -171,3 +171,72 @@ def test_reconfig_adds_node_which_catches_up():
     cluster.scheduler.advance(120.0)
     assert len(node5.app.ledger) >= 3, f"new node at {len(node5.app.ledger)}"
     cluster.assert_ledgers_consistent()
+
+
+def test_reconfig_evicts_current_leader():
+    """A committed reconfiguration whose new membership excludes the
+    CURRENT LEADER: the evicted leader shuts itself down after delivering
+    its own eviction, and the survivors resume under a leader recomputed
+    over the new node set without needing a view change.  Models the
+    leader-unavailable-after-reconfig situation of reference
+    test/reconfig_test.go:483 (TestViewChangeAfterReconfig)."""
+    cluster = Cluster(5, config_tweaks=FAST)
+    install_reconfig_hook(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    cluster.submit_to_all(reconfig_request("rm-leader", [2, 3, 4, 5]))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4, 5], max_time=300.0)
+    cluster.scheduler.advance(30.0)
+
+    assert cluster.nodes[1].consensus is None or not cluster.nodes[1].consensus._running, (
+        "evicted ex-leader did not shut down"
+    )
+    cluster.nodes[1].running = False  # exclude from ledger checks
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(3, node_ids=[2, 3, 4, 5], max_time=600.0), (
+        "survivors did not resume ordering under the recomputed leader"
+    )
+    cluster.assert_ledgers_consistent()
+    # Leadership was recomputed over the new node set — no view change ran.
+    assert all(
+        cluster.nodes[i].consensus.controller.curr_view_number == 0
+        for i in (2, 3, 4, 5)
+    )
+
+
+def test_view_change_right_after_reconfig():
+    """The leader dies immediately after a reconfiguration commits: the
+    ensuing view change must run under the NEW membership and quorum
+    (n=4 after removing a follower from 5), not the old one.  Parity
+    family: reference test/reconfig_test.go:483."""
+    cluster = Cluster(5, config_tweaks=FAST)
+    install_reconfig_hook(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    # Shrink membership to {1,2,3,4} (drops follower 5)...
+    cluster.submit_to_all(reconfig_request("rm5", [1, 2, 3, 4]))
+    assert cluster.run_until_ledger(2, node_ids=[1, 2, 3, 4], max_time=300.0)
+    cluster.scheduler.advance(10.0)
+    # The eviction must actually have taken node 5 down — otherwise the
+    # ensuing view change could reach the OLD n=5 quorum through it and
+    # this test would prove nothing about the new membership.
+    n5 = cluster.nodes[5].consensus
+    assert n5 is None or not n5._running, "evicted node 5 did not shut down"
+    cluster.nodes[5].running = False
+
+    # ...then kill the leader at once.  The view change needs quorum 3 of
+    # the new n=4 — exactly the three survivors.
+    cluster.nodes[1].crash()
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(3, node_ids=[2, 3, 4], max_time=900.0), (
+        "view change under the post-reconfig membership stalled"
+    )
+    cluster.assert_ledgers_consistent()
+    assert all(
+        cluster.nodes[i].consensus.controller.curr_view_number >= 1
+        for i in (2, 3, 4)
+    )
