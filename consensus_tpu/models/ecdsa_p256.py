@@ -35,7 +35,10 @@ N = p256.N
 
 _WINDOW_BITS = 4
 _WINDOWS = 256 // _WINDOW_BITS
-_TABLE = 1 << _WINDOW_BITS
+#: Signed digits: |d| <= 8 needs multiples 0..8 of Q (9 entries, 7 adds to
+#: build) instead of 0..15 (15 adds), and shrinks every step's one-hot
+#: lookup contraction from 16 rows to 9.  Negation is one field sub.
+_TABLE_SIGNED = 9
 
 
 def _be_bytes_to_limb_rows(rows_be: np.ndarray) -> np.ndarray:
@@ -44,16 +47,32 @@ def _be_bytes_to_limb_rows(rows_be: np.ndarray) -> np.ndarray:
     return rows_be[:, ::-1]
 
 
-def _scalars_to_window_digits(values: list[int]) -> np.ndarray:
-    """Scalars -> (64, n) 4-bit digits, MSB window first."""
+def _scalars_to_signed_window_digits(values: list[int]) -> np.ndarray:
+    """Scalars -> (65, n) SIGNED 4-bit digits in [-8, 7], wire-encoded as
+    d+8 (uint8), MSB window first.
+
+    Unlike Ed25519's k < L < 2^253 (top window can never overflow), u2 can
+    occupy all 256 bits (u2 < n ~ 2^256), so the LSB-to-MSB recoding carry
+    CAN escape the top window.  The carry c in {0, 1} is prepended as a
+    65th most-significant window: the Horner scan just consumes it first
+    (its 4 doubles act on the identity, and 64 subsequent x16 rounds give
+    it weight 2^256 exactly)."""
     n = len(values)
     rows = np.zeros((n, 32), dtype=np.uint8)
     for i, v in enumerate(values):
         rows[i] = np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8)
     bits = np.unpackbits(rows, axis=-1, bitorder="little")  # (n, 256) LSB first
     weights = np.array([1, 2, 4, 8], dtype=np.int32)
-    digits = bits.reshape(n, _WINDOWS, _WINDOW_BITS) @ weights
-    return np.ascontiguousarray(digits[:, ::-1].T).astype(np.uint8)
+    u = bits.reshape(n, _WINDOWS, _WINDOW_BITS) @ weights  # (n, 64) LSB first
+    d = np.zeros_like(u)
+    carry = np.zeros(n, dtype=u.dtype)
+    for j in range(_WINDOWS):
+        t = u[:, j] + carry
+        over = t >= 8
+        d[:, j] = np.where(over, t - 16, t)
+        carry = over.astype(u.dtype)
+    full = np.concatenate([carry[:, None], d[:, ::-1]], axis=1)  # (n, 65) MSB first
+    return np.ascontiguousarray(full.T + 8).astype(np.uint8)
 
 
 def _scalars_to_comb_digits8(values: list[int]) -> np.ndarray:
@@ -71,7 +90,8 @@ def verify_impl(
     qx: jnp.ndarray,        # (32, batch) public key X limbs
     qy: jnp.ndarray,        # (32, batch) public key Y limbs
     u1_digits: jnp.ndarray, # (32, batch) 8-bit comb digits of u1 = e/s, LSB first
-    u2_digits: jnp.ndarray, # (64, batch) 4-bit windows of u2 = r/s, MSB first
+    u2_digits: jnp.ndarray, # (65, batch) signed 4-bit windows of u2 = r/s
+                            # (encoded d+8), MSB first incl. recoding carry
     r1: jnp.ndarray,        # (32, batch) r as field limbs
     r2: jnp.ndarray,        # (32, batch) r + n as field limbs (when valid)
     has_r2: jnp.ndarray,    # (batch,) whether r + n < p
@@ -80,9 +100,11 @@ def verify_impl(
     """Un-jitted kernel body; shards over the trailing batch axis.
 
     R' = u1*G + u2*Q split by operand class: the variable half [u2]Q runs
-    the 4-bit Horner scan (64 steps of 4 doubles + 1 table add; j*Q built
-    per batch); the fixed-base half [u1]G — G is a compile-time constant —
-    uses the 8-bit comb (:func:`consensus_tpu.ops.p256.fixed_base_mul_comb`):
+    the signed-4-bit Horner scan (65 windows incl. the recoding carry, each
+    4 doubles + 1 table add; the 9-entry |d|*Q table built per batch, sign
+    applied by a mul-free negate); the fixed-base half [u1]G — G is a
+    compile-time constant — uses the 8-bit comb
+    (:func:`consensus_tpu.ops.p256.fixed_base_mul_comb`):
     32 constant lookups + adds, zero doubles, no per-batch table."""
     # Inputs ship as uint8 (limbs/digits all fit) — 4x less transfer;
     # widen to the compute dtypes on device.
@@ -94,17 +116,20 @@ def verify_impl(
     r2 = r2.astype(jnp.float32)
     q = p256.affine_like(qx, qy)
     q_ok = p256.on_curve(qx, qy)
-    q_table = p256.multiples_table(q, _TABLE)
-    lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]
+    q_table = p256.multiples_table(q, _TABLE_SIGNED)
+    lanes = jnp.arange(_TABLE_SIGNED, dtype=jnp.int32)[:, None]
 
-    def step(acc: p256.Point, d2):
-        oh2 = (d2[None] == lanes).astype(jnp.float32)
+    def step(acc: p256.Point, w):
+        d = w - 8  # signed digit in [-8, 7] ({0, 1} for the carry window)
+        oh2 = (jnp.abs(d)[None] == lanes).astype(jnp.float32)
         # 4 doubles as an inner scan: one double body in the graph instead
         # of four (trace/compile-size economy, identical runtime schedule).
         acc, _ = jax.lax.scan(
             lambda a, _: (p256.double(a), None), acc, None, length=4
         )
-        acc = p256.add(acc, p256.table_lookup(q_table, oh2))
+        t = p256.table_lookup(q_table, oh2)
+        t = p256.select(d < 0, p256.negate(t), t)
+        acc = p256.add(acc, t)
         return acc, None
 
     acc, _ = jax.lax.scan(step, p256.identity_like(qx), u2_digits)
@@ -239,7 +264,7 @@ class EcdsaP256BatchVerifier:
             _be_bytes_to_limb_rows(qx_rows),
             _be_bytes_to_limb_rows(qy_rows),
             _scalars_to_comb_digits8(u1s),
-            _scalars_to_window_digits(u2s),
+            _scalars_to_signed_window_digits(u2s),
             _be_bytes_to_limb_rows(r1_rows),
             _be_bytes_to_limb_rows(r2_rows),
             has_r2,

@@ -138,6 +138,11 @@ def double(p: Point) -> Point:
     return Point(x=x3, y=y3, z=z3)
 
 
+def negate(p: Point) -> Point:
+    """-(X : Y : Z) = (X : -Y : Z) — one mul-free field subtraction."""
+    return Point(x=p.x, y=fp.sub(p.y * 0, p.y), z=p.z)
+
+
 def select(cond: jnp.ndarray, p: Point, q: Point) -> Point:
     return Point(
         x=fp.select(cond, p.x, q.x),
