@@ -22,12 +22,32 @@ reference's per-signature path.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 BATCH = 16384
 DEVICE_ITERS = 5
 HOST_SAMPLE = 512
+
+#: Machine-readable measurement trail: refreshed after every successful live
+#: run, reported (with ``stale: true``) when the device is unreachable, so
+#: the BENCH_r* artifact chain never loses the last good number to a wedged
+#: tunnel (VERDICT r3 weak #6 / ADVICE r3 #1).
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BASELINE_LAST_GOOD.json")
+
+#: Total budget for device-probe retries.  The tunnel wedges transiently;
+#: retrying across the run window (instead of failing on the first probe)
+#: is the difference between a red artifact and a number.  The default is
+#: sized to fit a ~300 s driver budget WITH the failure JSON still printed
+#: (a run killed mid-retry loses the last_good trail entirely): a hung
+#: probe burns its full 90 s timeout, so 120 s means one hung probe + stop,
+#: while fast-failing probes (connection refused) get several retries.
+#: Override with CTPU_BENCH_RETRY_WINDOW (seconds); 0 disables retries.
+RETRY_WINDOW = float(os.environ.get("CTPU_BENCH_RETRY_WINDOW", "120"))
+PROBE_TIMEOUT = 90.0
 
 
 def make_signatures(n: int):
@@ -171,24 +191,83 @@ def bench_p256(msgs, sigs, keys) -> tuple[float, float]:
     return device_rate, host_rate
 
 
-def _probe_device(timeout: float = 90.0) -> bool:
-    """The TPU tunnel can wedge indefinitely; probe it on a side thread so a
-    dead device yields an honest failure line instead of a hung benchmark."""
-    import threading
+def _probe_device_once(timeout: float = PROBE_TIMEOUT) -> bool:
+    """Probe the device in a SUBPROCESS: a wedged tunnel hangs the probe
+    process, not this one, and a later retry starts from a fresh backend
+    (an in-process jax whose first contact hung stays poisoned even after
+    the tunnel recovers)."""
+    code = (
+        "import jax.numpy as jnp; "
+        "assert float(jnp.sum(jnp.ones((8, 8)))) == 64.0"
+    )
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", code], timeout=timeout,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        return False
 
-    ok = threading.Event()
 
-    def probe():
-        import jax
-        import jax.numpy as jnp
+def _probe_device_with_retries(window: float = RETRY_WINDOW) -> bool:
+    """Retry probes across the run window with a linear backoff; the tunnel
+    often returns within minutes."""
+    deadline = time.monotonic() + window
+    attempt = 0
+    while True:
+        if _probe_device_once():
+            return True
+        attempt += 1
+        delay = min(30.0 * attempt, 120.0)
+        if time.monotonic() + delay >= deadline:
+            return False
+        print(
+            f"# device probe {attempt} failed; retrying in {delay:.0f}s "
+            f"({deadline - time.monotonic():.0f}s left in window)",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
 
-        if float(jnp.sum(jnp.ones((8, 8)))) == 64.0:
-            ok.set()
 
-    thread = threading.Thread(target=probe, daemon=True)
-    thread.start()
-    thread.join(timeout)
-    return ok.is_set()
+def _load_last_good(metric: str) -> dict:
+    try:
+        with open(LAST_GOOD_PATH) as fh:
+            return json.load(fh).get(metric, {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_last_good(metric: str, value: float, vs_baseline: float) -> None:
+    """Refresh the measurement trail after a successful live run."""
+    try:
+        with open(LAST_GOOD_PATH) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        data = {}
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(LAST_GOOD_PATH),
+        ).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        commit = "unknown"
+    data[metric] = {
+        "value": round(value, 1),
+        "unit": "sigs/sec",
+        "vs_baseline": round(vs_baseline, 3),
+        "commit": commit or "unknown",
+        "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "hardware": "v5e-1 via tunnel",
+    }
+    tmp = LAST_GOOD_PATH + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(data, fh, indent=2)
+        fh.write("\n")
+    os.replace(tmp, LAST_GOOD_PATH)
 
 
 def main() -> None:
@@ -200,16 +279,13 @@ def main() -> None:
         if len(sys.argv) > 1 and sys.argv[1] == "p256"
         else "ed25519_verify_throughput"
     )
-    if not _probe_device():
-        # The last live measurement is spelled inside the error STRING only
-        # (never as numeric fields a harness could misread as this run's
-        # result); BASELINE.md carries the full tables.
-        last = {
-            "ed25519_verify_throughput": "83498 sigs/sec (17.5x OpenSSL), "
-            "2026-07-29T13:55Z commit 292435a v5e-1",
-            "ecdsa_p256_verify_throughput": "31623 sigs/sec (3.69x OpenSSL), "
-            "2026-07-29T13:58Z commit 292435a v5e-1",
-        }[metric]
+    if not _probe_device_with_retries():
+        # Emit the last good measurement as a MACHINE-READABLE block marked
+        # stale=true — this run's own value stays 0 (a harness must never
+        # mistake the trail for this run's result), but the artifact chain
+        # keeps the measurement provenance without a human reading
+        # BASELINE.md.
+        last_good = _load_last_good(metric)
         print(
             json.dumps(
                 {
@@ -217,8 +293,11 @@ def main() -> None:
                     "value": 0,
                     "unit": "sigs/sec",
                     "vs_baseline": 0,
-                    "error": "device unreachable (TPU tunnel wedged); "
-                             f"last live measurement: {last} — see BASELINE.md",
+                    "error": "device unreachable (TPU tunnel wedged; "
+                             f"retried for {RETRY_WINDOW:.0f}s)",
+                    "last_good": dict(last_good, stale=True)
+                    if last_good
+                    else None,
                 }
             )
         )
@@ -234,6 +313,7 @@ def main() -> None:
         msgs, sigs, keys = make_signatures(BATCH)
         device_rate = bench_device(msgs, sigs, keys)
         host_rate = bench_host(msgs, sigs, keys)
+    _save_last_good(metric, device_rate, device_rate / host_rate)
     print(
         json.dumps(
             {

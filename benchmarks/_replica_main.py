@@ -1,0 +1,219 @@
+"""Replica process entry for the multi-process benchmark: ONE consensus
+replica in its own OS process, talking to its peers over real TCP and (in
+device mode) to the shared TPU through the verification sidecar.
+
+This is the reference's deployment shape — every Go replica is its own
+process reached through Comm (reference pkg/api/dependencies.go:22-30) —
+so the measurement carries no shared-GIL handicap: each replica's protocol
+path (codec, digests, WAL, TCP) runs on its own interpreter.
+
+Replica 1 runs the request feeder and prints the measurement JSON line on
+stdout when its window closes; other replicas run until killed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REQ_TAG = b"ctpu/request"
+
+
+class _StubCluster:
+    """Cross-process deployments have no in-process ledger registry; sync
+    answers empty (healthy-cluster benchmark: protocol-level assist replies
+    cover transient gaps)."""
+
+    nodes: dict = {}
+
+    def longest_ledger(self, *, exclude):
+        return []
+
+    def reconfig_of(self, proposal):
+        from consensus_tpu.types import Reconfig
+
+        return Reconfig()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node-id", type=int, required=True)
+    ap.add_argument("--n", type=int, required=True)
+    ap.add_argument("--ports", required=True,
+                    help="comma-separated ports for nodes 1..n")
+    ap.add_argument("--family", choices=["ed25519", "p256"], required=True)
+    ap.add_argument("--verify", choices=["host", "device"], required=True)
+    ap.add_argument("--sidecar", default="",
+                    help="unix socket path of the verification sidecar "
+                    "(device mode)")
+    ap.add_argument("--batch", type=int, required=True)
+    ap.add_argument("--rotate", type=int, default=0)
+    ap.add_argument("--clients", type=int, default=16)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--warmup", type=float, default=4.0)
+    ap.add_argument("--presign", type=int, default=60000)
+    args = ap.parse_args()
+
+    from benchmarks.mp_common import (
+        make_client_keyring,
+        make_node_signer,
+        make_raw_engine,
+        make_verifier_class,
+    )
+    from consensus_tpu.config import Configuration
+    from consensus_tpu.consensus import Consensus
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+    from consensus_tpu.net import SidecarVerifierClient, TcpComm
+    from consensus_tpu.runtime import RealtimeScheduler
+    from consensus_tpu.testing.app import MemWAL
+    from consensus_tpu.testing.crypto_app import SignedRequestApp
+
+    node_ids = list(range(1, args.n + 1))
+    ports = [int(p) for p in args.ports.split(",")]
+    addrs = {i: ("127.0.0.1", ports[i - 1]) for i in node_ids}
+
+    # The host path IS the reference-equivalent engine: a sequential
+    # OpenSSL loop on this process's own core.
+    host_engine = make_raw_engine(args.family, min_device_batch=10**9)
+    if args.verify == "device":
+        engine = SidecarVerifierClient(
+            args.sidecar,
+            local_engine=host_engine,
+            bypass_below=64,
+            request_timeout=60.0,
+        )
+    else:
+        engine = host_engine
+
+    signer = make_node_signer(args.family, args.node_id)
+    keys = {
+        i: make_node_signer(args.family, i).public_bytes for i in node_ids
+    }
+    verifier = make_verifier_class(args.family)(keys, engine=engine)
+    clients = make_client_keyring(args.family, args.clients)
+
+    cluster = _StubCluster()
+    app = SignedRequestApp(
+        args.node_id, cluster, signer, verifier,
+        client_keys=clients.public_keys, engine=engine, sig_len=64,
+    )
+
+    rt = RealtimeScheduler()
+    rt.start(thread_name=f"replica-{args.node_id}")
+    consensus_holder: list = [None]
+
+    def route(sender, payload, is_request):
+        c = consensus_holder[0]
+        if c is None:
+            return
+        if is_request:
+            c.handle_request(sender, payload)
+        else:
+            c.handle_message(sender, payload)
+
+    comm = TcpComm(args.node_id, addrs, route, reconnect_backoff=0.05)
+    comm.start()
+
+    provider = InMemoryProvider()
+    consensus = Consensus(
+        config=Configuration(
+            self_id=args.node_id,
+            leader_rotation=args.rotate > 0,
+            decisions_per_leader=args.rotate,
+            request_batch_max_count=args.batch,
+            request_batch_max_interval=0.02,
+            request_pool_size=max(2000, 3 * args.batch),
+        ),
+        scheduler=rt,
+        comm=comm,
+        application=app,
+        assembler=app,
+        wal=MemWAL([]),
+        signer=app,
+        verifier=app,
+        request_inspector=app.inspector,
+        synchronizer=app,
+        metrics=Metrics(provider),
+    )
+    consensus.start()
+    consensus_holder[0] = consensus
+
+    if args.node_id != 1:
+        # Followers serve until the orchestrator kills the process.
+        while True:
+            time.sleep(3600)
+
+    # --- node 1: feeder + measurement ------------------------------------
+    print(f"# presigning {args.presign} requests...", file=sys.stderr)
+    t0 = time.time()
+    presigned = [
+        clients.make_request(i % args.clients, i) for i in range(args.presign)
+    ]
+    print(f"# presigned in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    stop = threading.Event()
+    exhausted = [False]
+
+    def feeder():
+        sem = threading.Semaphore(max(1500, 2 * args.batch))
+
+        def release(err):
+            sem.release()
+
+        for raw in presigned:
+            if stop.is_set():
+                return
+            sem.acquire()
+            consensus.submit_request(raw, release)
+        exhausted[0] = True
+
+    threading.Thread(target=feeder, daemon=True).start()
+
+    ledger = app.ledger
+    time.sleep(args.warmup)
+    lat = provider.observations("view_latency_batch_processing")
+    start_blocks, start_lat = len(ledger), len(lat)
+    start_tx = sum(int.from_bytes(d.proposal.payload[:4], "big") for d in ledger)
+    t0 = time.time()
+    time.sleep(args.seconds)
+    elapsed = time.time() - t0
+    end_blocks = len(ledger)
+    end_tx = sum(int.from_bytes(d.proposal.payload[:4], "big") for d in ledger)
+    window_lat = sorted(lat[start_lat:])
+    ran_dry = exhausted[0]
+    stop.set()
+
+    def pct(p):
+        if not window_lat:
+            return None
+        return round(
+            1000 * window_lat[min(len(window_lat) - 1, int(p * len(window_lat)))],
+            2,
+        )
+
+    print(
+        json.dumps(
+            {
+                "tx_per_sec": round((end_tx - start_tx) / elapsed, 1),
+                "blocks_per_sec": round((end_blocks - start_blocks) / elapsed, 1),
+                "p50_commit_latency_ms": pct(0.50),
+                "p90_commit_latency_ms": pct(0.90),
+                "presign_exhausted": ran_dry,
+            }
+        ),
+        flush=True,
+    )
+    # Give peers a moment to finish in-flight work, then exit; the
+    # orchestrator tears the cluster down.
+    time.sleep(0.5)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Shared pieces for the MULTI-PROCESS benchmark: deterministic identities
+(every process derives the same keys from the same seeds — there is no
+in-process registry to share) and engine construction.
+
+Deployment shape (VERDICT r3 #2): n replica OS processes over real TCP
+(the reference's Comm contract is always cross-process,
+reference pkg/api/dependencies.go:22-30) sharing ONE device through the
+verification sidecar (consensus_tpu/net/sidecar.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+_NODE_TAG = b"ctpu-mp-node:%d"
+_CLIENT_TAG = b"ctpu-mp-client:%d"
+
+
+def _seed32(tag: bytes, i: int) -> bytes:
+    return hashlib.sha256(tag % i).digest()
+
+
+def make_node_signer(family: str, node_id: int):
+    if family == "ed25519":
+        from consensus_tpu.models import Ed25519Signer
+
+        return Ed25519Signer(node_id, private_key_bytes=_seed32(_NODE_TAG, node_id))
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    from consensus_tpu.models import EcdsaP256Signer
+    from consensus_tpu.models.ecdsa_p256 import N
+
+    scalar = 1 + int.from_bytes(_seed32(_NODE_TAG, node_id), "big") % (N - 1)
+    return EcdsaP256Signer(
+        node_id, private_key=ec.derive_private_key(scalar, ec.SECP256R1())
+    )
+
+
+def make_client_keyring(family: str, n_clients: int):
+    from consensus_tpu.testing.crypto_app import ClientKeyring
+
+    if family == "ed25519":
+        from consensus_tpu.models import Ed25519Signer
+
+        signers = [
+            Ed25519Signer(10_000 + i, private_key_bytes=_seed32(_CLIENT_TAG, i))
+            for i in range(n_clients)
+        ]
+    else:
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        from consensus_tpu.models import EcdsaP256Signer
+        from consensus_tpu.models.ecdsa_p256 import N
+
+        signers = []
+        for i in range(n_clients):
+            scalar = 1 + int.from_bytes(_seed32(_CLIENT_TAG, i), "big") % (N - 1)
+            signers.append(
+                EcdsaP256Signer(
+                    10_000 + i,
+                    private_key=ec.derive_private_key(scalar, ec.SECP256R1()),
+                )
+            )
+    return ClientKeyring(signers)
+
+
+def make_raw_engine(family: str, *, min_device_batch: int, pad_to: int = 0):
+    if family == "ed25519":
+        from consensus_tpu.models.ed25519 import Ed25519BatchVerifier
+
+        return Ed25519BatchVerifier(
+            min_device_batch=min_device_batch, pad_to=pad_to
+        )
+    from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
+
+    return EcdsaP256BatchVerifier(min_device_batch=min_device_batch, pad_to=pad_to)
+
+
+def make_verifier_class(family: str):
+    """The signature-verification mixin with the app half stubbed (the app
+    half lives in SignedRequestApp)."""
+    from consensus_tpu.models import EcdsaP256VerifierMixin, Ed25519VerifierMixin
+
+    mixin = Ed25519VerifierMixin if family == "ed25519" else EcdsaP256VerifierMixin
+
+    class _SigVerifier(mixin):
+        def verify_proposal(self, proposal):
+            raise NotImplementedError
+
+        def verify_request(self, raw):
+            raise NotImplementedError
+
+        def verification_sequence(self):
+            return 0
+
+        def requests_from_proposal(self, proposal):
+            return []
+
+    return _SigVerifier

@@ -104,6 +104,9 @@ class PersistedState:
         #: and, if one followed it, our commit for it.
         self._mem_proposed: Optional[ProposedRecord] = None
         self._mem_commit: Optional[SavedCommit] = None
+        #: The record object most recently appended this run — the guard for
+        #: the verified-upgrade append (it must only ever replace the tail).
+        self._last_written: Optional[SavedMessage] = None
         try:
             last = self._last_record()
             if isinstance(last, SavedCommit) and len(self.entries) >= 2:
@@ -133,6 +136,7 @@ class PersistedState:
         elif isinstance(record, SavedCommit):
             self._in_flight.store_prepared(record.commit.view, record.commit.seq)
             self._mem_commit = record
+        self._last_written = record
         self._wal.append(
             encode_saved(record),
             truncate_to=isinstance(record, ProposedRecord),
@@ -194,8 +198,18 @@ class PersistedState:
         """Flip the in-memory ProposedRecord to verified once the (leader's)
         deferred verification succeeds, so a mid-run view restart
         (reseed_if_inflight_matches) does not re-verify a proposal this
-        process already verified.  The on-disk record is left as written —
-        a crash-restore re-verifies, the conservative side."""
+        process already verified.
+
+        If the unverified record is still the WAL tail, an upgraded copy is
+        appended so a CRASH-restore skips the re-verify too: re-running
+        verification after a crash is conservative, but it spuriously fails
+        when verifier state (e.g. verification_sequence) legitimately
+        advanced between the write and the restore — deposing a leader that
+        had already verified the proposal pre-crash (ADVICE r3).  The
+        append is best-effort and tail-guarded: if ANY record followed (a
+        commit, a view-change), the upgrade is skipped — a commit makes it
+        moot (PREPARED restore doesn't re-verify) and anything else must
+        stay the tail the restore logic sees."""
         rec = self._mem_proposed
         if (
             rec is not None
@@ -203,7 +217,17 @@ class PersistedState:
             and rec.pre_prepare.view == view_number
             and rec.pre_prepare.seq == seq
         ):
-            self._mem_proposed = dataclasses.replace(rec, verified=True)
+            upgraded = dataclasses.replace(rec, verified=True)
+            self._mem_proposed = upgraded
+            if self._last_written is rec:
+                try:
+                    self._wal.append(encode_saved(upgraded), truncate_to=True)
+                    self._last_written = upgraded
+                except Exception:
+                    logger.exception(
+                        "verified-upgrade append failed; a crash-restore "
+                        "will re-verify (liveness-only cost)"
+                    )
 
     def _enter_proposed(self, record: ProposedRecord, view: View) -> None:
         """Shared phase-reentry: seed ``view`` into PROPOSED from a

@@ -312,6 +312,13 @@ class EcdsaP256BatchVerifier:
                 out[i] = False
         return out
 
+    def verify_host(self, messages, signatures, public_keys) -> np.ndarray:
+        """Public seam for the coalescer's wedged-device escape hatch:
+        verify on the host regardless of batch size, same semantics as the
+        device path.  (A forwarding method, not a class-level alias, so
+        subclass overrides of ``_verify_host`` take effect here too.)"""
+        return self._verify_host(messages, signatures, public_keys)
+
 
 def raw_signature_from_der(der: bytes) -> bytes:
     """DER ECDSA signature -> 64-byte big-endian r || s."""

@@ -378,5 +378,12 @@ class Ed25519BatchVerifier:
                 out[i] = False
         return out
 
+    def verify_host(self, messages, signatures, public_keys) -> np.ndarray:
+        """Public seam for the coalescer's wedged-device escape hatch:
+        verify on the host regardless of batch size, same strict semantics
+        as the device path.  (A forwarding method, not a class-level alias,
+        so subclass overrides of ``_verify_host`` take effect here too.)"""
+        return self._verify_host(messages, signatures, public_keys)
+
 
 __all__ = ["Ed25519BatchVerifier", "L"]

@@ -12,6 +12,7 @@ network RTT to not hurt p50 commit latency (SURVEY §7 hard part 3).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional, Sequence
@@ -19,6 +20,8 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
+
+logger = logging.getLogger("consensus_tpu.models.engine")
 
 
 def _split_results(results: Sequence, sizes: Sequence[int]):
@@ -98,15 +101,20 @@ class BatchCoalescer:
 
 
 class _Pending:
-    __slots__ = ("messages", "signatures", "keys", "done", "result", "error")
+    __slots__ = (
+        "messages", "signatures", "keys", "done", "result", "error", "waiterless",
+    )
 
-    def __init__(self, messages, signatures, keys):
+    def __init__(self, messages, signatures, keys, *, waiterless: bool = False):
         self.messages = messages
         self.signatures = signatures
         self.keys = keys
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # Recovery probes have no waiter: nobody consumes their results, so
+        # failure paths shouldn't burn host CPU computing them.
+        self.waiterless = waiterless
 
 
 class ThreadCoalescingVerifier:
@@ -139,9 +147,16 @@ class ThreadCoalescingVerifier:
     (heartbeats, view-change messages, quorum votes) shouldn't pay the
     window latency.  Match it to the engine's ``min_device_batch``.
 
-    ``wait_timeout``: a wedged device (e.g. a hung TPU tunnel) must fail
-    loudly, not block every replica thread forever — waiters raise after
-    this many seconds.
+    ``wait_timeout``: a wedged device (e.g. a hung TPU tunnel) must not
+    block a replica past its protocol timeouts.  A waiter whose flush has
+    not completed after this many seconds falls back to the engine's host
+    path (``engine.verify_host``) on its own thread — the decision still
+    completes, just without acceleration — and the coalescer marks the
+    device *suspect* so subsequent submissions skip the queue entirely and
+    go straight to host.  The first successful device flush clears the
+    flag (tunnel recovered).  Size it above the worst-case first-compile
+    time; engines without a ``verify_host`` method keep the old fail-loud
+    behavior (raise on timeout).
     """
 
     def __init__(
@@ -152,7 +167,7 @@ class ThreadCoalescingVerifier:
         max_batch: int = 8192,
         hard_cap: int = 0,
         bypass_below: int = 0,
-        wait_timeout: float = 300.0,
+        wait_timeout: Optional[float] = None,
         name: str = "verify-coalescer",
     ) -> None:
         self._engine = engine
@@ -160,13 +175,30 @@ class ThreadCoalescingVerifier:
         self._max_batch = max_batch
         self._hard_cap = hard_cap if hard_cap > 0 else max(max_batch, 1)
         self._bypass_below = bypass_below
+        self._host_fallback = getattr(engine, "verify_host", None)
+        if wait_timeout is None:
+            # With a host escape hatch, timing out early just means one
+            # slower-but-correct decision (and the flag clears on the next
+            # successful flush, e.g. when a long first compile lands).
+            # Without one, a timeout is a hard error — keep the generous
+            # budget that covers worst-case first compiles.
+            wait_timeout = 60.0 if self._host_fallback is not None else 300.0
         self._wait_timeout = wait_timeout
         self._cv = threading.Condition()
         self._pending: list[_Pending] = []
         self._count = 0
         self._closed = False
+        self._device_suspect = False
+        self._probe_interval = 30.0
+        self._last_probe = -float("inf")
         self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
         self._thread.start()
+
+    @property
+    def device_suspect(self) -> bool:
+        """True while the device is considered wedged (submissions are
+        routed straight to the host path)."""
+        return self._device_suspect
 
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         n = len(messages)
@@ -174,6 +206,12 @@ class ThreadCoalescingVerifier:
             raise ValueError("batch length mismatch")
         if n == 0:
             return np.zeros(0, dtype=bool)
+        if self._device_suspect and self._host_fallback is not None:
+            # Wedged device: don't queue behind a flusher that may be stuck
+            # inside a hung device call — verify on the caller's thread.
+            # A no-wait copy of the work probes the device for recovery.
+            self._maybe_probe_device(messages, signatures, public_keys)
+            return np.asarray(self._host_fallback(messages, signatures, public_keys))
         if n < self._bypass_below:
             # Too small to ever ride the device: verify on the caller's
             # thread, zero added latency (the engine routes it host-side).
@@ -199,10 +237,13 @@ class ThreadCoalescingVerifier:
             self._cv.notify_all()
         for item in items:
             if not item.done.wait(timeout=self._wait_timeout):
-                raise RuntimeError(
-                    f"verify flush did not complete within {self._wait_timeout}s "
-                    "(wedged device?)"
-                )
+                if self._host_fallback is None:
+                    raise RuntimeError(
+                        f"verify flush did not complete within {self._wait_timeout}s "
+                        "(wedged device?)"
+                    )
+                self._abandon_to_host(items)
+                break
             if item.error is not None:
                 # A merged flush fails for every waiter; raising the SAME
                 # exception object from N threads would interleave their
@@ -214,6 +255,61 @@ class ThreadCoalescingVerifier:
             return items[0].result
         return np.concatenate([item.result for item in items])
 
+    def _maybe_probe_device(self, messages, signatures, public_keys) -> None:
+        """While suspect, periodically enqueue a no-waiter copy of real work
+        so the flusher (once it unwedges / recovers) runs a device flush and
+        clears the flag.  At most one probe is queued at a time, and probes
+        are rate-limited — a stuck flusher can't accumulate a backlog."""
+        now = time.monotonic()
+        with self._cv:
+            if (
+                self._closed
+                or self._pending
+                or now - self._last_probe < self._probe_interval
+            ):
+                return
+            self._last_probe = now
+            cap = min(len(messages), self._hard_cap)
+            item = _Pending(
+                list(messages[:cap]),
+                list(signatures[:cap]),
+                list(public_keys[:cap]),
+                waiterless=True,
+            )
+            self._pending.append(item)
+            self._count += cap
+            self._cv.notify_all()
+
+    def _abandon_to_host(self, items: list["_Pending"]) -> None:
+        """Waiter-side escape hatch: the flush never completed within
+        ``wait_timeout`` (hung device call, e.g. a wedged TPU tunnel).
+        Mark the device suspect, pull any chunks still queued out of the
+        flusher's reach, and verify everything on the caller's thread via
+        the engine's host path so the replica completes its decision within
+        protocol timeouts.  Results the stuck flusher produces later for
+        these items are simply ignored."""
+        with self._cv:
+            if not self._device_suspect:
+                logger.error(
+                    "verify flush did not complete within %.1fs — device "
+                    "suspect; falling back to HOST verification (slower, "
+                    "still correct) until a device flush succeeds",
+                    self._wait_timeout,
+                )
+            self._device_suspect = True
+            for item in items:
+                if item in self._pending:
+                    self._pending.remove(item)
+                    self._count -= len(item.messages)
+        for item in items:
+            if item.done.is_set() and item.error is None and item.result is not None:
+                continue  # completed while we were escaping — keep it
+            item.result = np.asarray(
+                self._host_fallback(item.messages, item.signatures, item.keys)
+            )
+            item.error = None
+            item.done.set()
+
     def close(self) -> None:
         with self._cv:
             self._closed = True
@@ -223,7 +319,12 @@ class ThreadCoalescingVerifier:
         # the device wedged.
         self._thread.join(timeout=self._wait_timeout)
         if self._thread.is_alive():
-            raise RuntimeError("coalescer flusher did not exit (wedged device?)")
+            # Daemon thread — it can't block process exit; shutdown itself
+            # must not crash on a wedged device.
+            logger.error(
+                "coalescer flusher did not exit within %.1fs (wedged device?)",
+                self._wait_timeout,
+            )
 
     # -- flusher thread ----------------------------------------------------
 
@@ -266,11 +367,47 @@ class ThreadCoalescingVerifier:
             try:
                 results = np.asarray(self._engine.verify_batch(messages, signatures, keys))
                 slices = _split_results(results, [len(i.messages) for i in batch])
-            except BaseException as exc:  # propagate to every waiter
-                for item in batch:
+            except BaseException as exc:
+                if self._host_fallback is not None:
+                    # Device call failed fast (not hung): serve this flush
+                    # from the host path so waiters complete, and mark the
+                    # device suspect so new submissions skip the queue.
+                    logger.error(
+                        "device verify flush failed (%r) — serving %d "
+                        "signatures via HOST fallback; device suspect",
+                        exc,
+                        len(messages),
+                    )
+                    with self._cv:
+                        self._device_suspect = True
+                    for item in batch:
+                        if item.waiterless:
+                            item.done.set()  # failed probe: nothing to serve
+                            continue
+                        try:
+                            item.result = np.asarray(
+                                self._host_fallback(
+                                    item.messages, item.signatures, item.keys
+                                )
+                            )
+                        except BaseException as host_exc:
+                            # The host path failing too (e.g. malformed
+                            # inputs) must not kill the flusher thread —
+                            # deliver it as this waiter's error.
+                            item.error = host_exc
+                        item.done.set()
+                    continue
+                for item in batch:  # no host path: propagate to every waiter
                     item.error = exc
                     item.done.set()
                 continue
+            if self._device_suspect:
+                logger.warning(
+                    "device verify flush succeeded — clearing suspect flag, "
+                    "resuming device batching"
+                )
+                with self._cv:
+                    self._device_suspect = False
             for item, piece in zip(batch, slices):
                 item.result = piece
                 item.done.set()

@@ -1,5 +1,11 @@
 """Network transports: production Comm implementations (TCP over DCN)."""
 
 from consensus_tpu.net.transport import MAX_FRAME_BYTES, TcpComm
+from consensus_tpu.net.sidecar import SidecarVerifierClient, VerifySidecarServer
 
-__all__ = ["TcpComm", "MAX_FRAME_BYTES"]
+__all__ = [
+    "TcpComm",
+    "MAX_FRAME_BYTES",
+    "VerifySidecarServer",
+    "SidecarVerifierClient",
+]

@@ -1,0 +1,371 @@
+"""Verification sidecar: n replica *processes* sharing one TPU.
+
+The reference always deploys replicas as separate OS processes (its Comm
+contract is a network transport, reference pkg/api/dependencies.go:22-30);
+each Go process burns its own cores verifying signatures.  The TPU-native
+deployment shape (SURVEY §7 step 9) keeps one device per host and lets all
+co-located replica processes drain their signature sweeps into it through a
+tiny socket front: the sidecar process owns the engine (and the one
+compiled kernel shape) and coalesces concurrent requests from any number of
+replica processes into single device launches via
+:class:`consensus_tpu.models.engine.ThreadCoalescingVerifier`.
+
+Client side, :class:`SidecarVerifierClient` is a drop-in ``engine`` for the
+``Verifier`` mixins (same ``verify_batch`` contract).  With a
+``local_engine`` supplied it also inherits the wedged-device escape hatch:
+a sidecar that dies or stalls past ``request_timeout`` fails over to local
+host verification (slower, still correct) instead of wedging the replica.
+
+Framing (both directions, all integers big-endian):
+
+    u32 payload_len | u64 req_id | payload
+
+Request payload:  u32 count | count * (u32 mlen u32 slen u32 klen m s k)
+Response payload: u8 status (0=ok, 1=error) | count result bytes / utf-8 error
+
+Addresses: a ``(host, port)`` tuple serves TCP (cross-container), a string
+serves a unix domain socket (same-host, lower latency — the common shape).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+logger = logging.getLogger("consensus_tpu.net.sidecar")
+
+_FRAME = struct.Struct(">IQ")
+_ITEM = struct.Struct(">III")
+_MAX_FRAME = 256 * 1024 * 1024
+
+Address = Union[tuple, str]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("sidecar connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_frame(sock: socket.socket) -> tuple[int, bytes]:
+    header = _recv_exact(sock, _FRAME.size)
+    length, req_id = _FRAME.unpack(header)
+    if length > _MAX_FRAME:
+        raise ConnectionError(f"sidecar frame too large: {length}")
+    return req_id, _recv_exact(sock, length)
+
+
+def _write_frame(sock: socket.socket, req_id: int, payload: bytes) -> None:
+    sock.sendall(_FRAME.pack(len(payload), req_id) + payload)
+
+
+def encode_request(messages, signatures, keys) -> bytes:
+    parts = [struct.pack(">I", len(messages))]
+    for m, s, k in zip(messages, signatures, keys):
+        parts.append(_ITEM.pack(len(m), len(s), len(k)))
+        parts.append(bytes(m))
+        parts.append(bytes(s))
+        parts.append(bytes(k))
+    return b"".join(parts)
+
+
+def decode_request(payload: bytes) -> tuple[list, list, list]:
+    (count,) = struct.unpack_from(">I", payload, 0)
+    offset = 4
+    messages, signatures, keys = [], [], []
+    for _ in range(count):
+        mlen, slen, klen = _ITEM.unpack_from(payload, offset)
+        offset += _ITEM.size
+        messages.append(payload[offset : offset + mlen]); offset += mlen
+        signatures.append(payload[offset : offset + slen]); offset += slen
+        keys.append(payload[offset : offset + klen]); offset += klen
+    if offset != len(payload):
+        raise ValueError("trailing bytes in sidecar request")
+    return messages, signatures, keys
+
+
+class VerifySidecarServer:
+    """Socket front on a verification engine (typically a
+    ``ThreadCoalescingVerifier`` so concurrent replica processes merge into
+    one device launch).  One thread per connection reads requests; each
+    request is served on its own worker thread — a replica pipelining
+    decisions can have several requests in flight on one connection, and a
+    blocking coalescer call must not serialize them."""
+
+    def __init__(self, address: Address, engine) -> None:
+        self._address = address
+        self._engine = engine
+        self._listener: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+        self._stopping = False
+
+    @property
+    def address(self) -> Address:
+        """The bound address (with the real port once started)."""
+        return self._address
+
+    def start(self) -> None:
+        if isinstance(self._address, str):
+            try:
+                os.unlink(self._address)
+            except OSError:
+                pass
+            listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            listener.bind(self._address)
+        else:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(tuple(self._address))
+            self._address = listener.getsockname()
+        listener.listen(64)
+        self._listener = listener
+        thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="sidecar-accept"
+        )
+        thread.start()
+        self._threads.append(thread)
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        if isinstance(self._address, str):
+            try:
+                os.unlink(self._address)
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
+                if conn.family == socket.AF_INET else None
+            thread = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="sidecar-conn",
+            )
+            thread.start()
+            self._threads.append(thread)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        write_lock = threading.Lock()
+        try:
+            while True:
+                req_id, payload = _read_frame(conn)
+                threading.Thread(
+                    target=self._serve_request,
+                    args=(conn, write_lock, req_id, payload),
+                    daemon=True,
+                    name="sidecar-verify",
+                ).start()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_request(self, conn, write_lock, req_id: int, payload: bytes) -> None:
+        try:
+            messages, signatures, keys = decode_request(payload)
+            results = np.asarray(self._engine.verify_batch(messages, signatures, keys))
+            if len(results) != len(messages):
+                raise ValueError("engine returned wrong result count")
+            body = b"\x00" + np.asarray(results, dtype=np.uint8).tobytes()
+        except Exception as exc:  # serve the error, keep the connection
+            logger.exception("sidecar verify request %d failed", req_id)
+            body = b"\x01" + repr(exc).encode()
+        try:
+            with write_lock:
+                _write_frame(conn, req_id, body)
+        except OSError:
+            pass  # client went away; its loss
+
+
+class SidecarVerifierClient:
+    """Drop-in ``engine`` (the ``verify_batch`` contract) that forwards
+    batches to a :class:`VerifySidecarServer` over one multiplexed
+    connection.  Thread-safe: concurrent calls are tagged with request ids
+    and a single reader thread routes responses.
+
+    ``local_engine``: optional engine whose ``verify_host`` serves as the
+    escape hatch — if the sidecar is unreachable, errors, or stalls past
+    ``request_timeout``, verification falls back to the local host path
+    (logged loudly) instead of wedging the replica.
+
+    ``bypass_below``: batches smaller than this verify locally (via
+    ``local_engine.verify_host``) without a socket round trip — quorum-sized
+    checks and single signatures gain nothing from the device and shouldn't
+    pay the sidecar RTT + coalescing window.
+    """
+
+    def __init__(
+        self,
+        address: Address,
+        *,
+        local_engine=None,
+        request_timeout: float = 60.0,
+        connect_timeout: float = 5.0,
+        bypass_below: int = 0,
+    ) -> None:
+        self._address = address
+        self._timeout = request_timeout
+        self._connect_timeout = connect_timeout
+        self._local = local_engine
+        self._bypass_below = bypass_below if local_engine is not None else 0
+        self._lock = threading.Lock()  # guards socket create + sends
+        self._sock: Optional[socket.socket] = None
+        self._pending: dict[int, dict] = {}
+        self._next_id = 0
+        self._reader: Optional[threading.Thread] = None
+
+    # -- engine contract ---------------------------------------------------
+
+    def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
+        n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            raise ValueError("batch length mismatch")
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        if n < self._bypass_below:
+            return np.asarray(
+                self._local.verify_host(messages, signatures, public_keys)
+            )
+        try:
+            return self._roundtrip(messages, signatures, public_keys)
+        except Exception as exc:
+            if self._local is None:
+                raise
+            logger.error(
+                "sidecar verify failed (%r) — falling back to LOCAL host "
+                "verification for %d signatures",
+                exc,
+                n,
+            )
+            return np.asarray(
+                self._local.verify_host(messages, signatures, public_keys)
+            )
+
+    def verify_host(self, messages, signatures, public_keys) -> np.ndarray:
+        """Escape-hatch seam (used if this client is itself wrapped in a
+        coalescer): local host verification, bypassing the sidecar."""
+        if self._local is None:
+            raise RuntimeError("no local_engine configured")
+        return np.asarray(
+            self._local.verify_host(messages, signatures, public_keys)
+        )
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _ensure_connected(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        if isinstance(self._address, str):
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self._connect_timeout)
+        sock.connect(
+            self._address if isinstance(self._address, str)
+            else tuple(self._address)
+        )
+        sock.settimeout(None)
+        if sock.family == socket.AF_INET:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock,), daemon=True,
+            name="sidecar-client-reader",
+        )
+        self._reader.start()
+        return sock
+
+    def _roundtrip(self, messages, signatures, keys) -> np.ndarray:
+        payload = encode_request(messages, signatures, keys)
+        waiter = {"event": threading.Event(), "body": None}
+        send_error: Optional[OSError] = None
+        with self._lock:
+            sock = self._ensure_connected()
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = waiter
+            try:
+                _write_frame(sock, req_id, payload)
+            except OSError as exc:
+                self._pending.pop(req_id, None)
+                send_error = exc
+        if send_error is not None:
+            # Outside the lock: _drop_socket re-acquires it (calling it
+            # while held would self-deadlock and wedge every verify).
+            self._drop_socket(sock)
+            raise send_error
+        if not waiter["event"].wait(self._timeout):
+            self._pending.pop(req_id, None)
+            raise TimeoutError(
+                f"sidecar did not answer within {self._timeout}s"
+            )
+        body = waiter["body"]
+        if body is None:
+            raise ConnectionError("sidecar connection lost mid-request")
+        if body[0] != 0:
+            raise RuntimeError(f"sidecar error: {body[1:].decode(errors='replace')}")
+        results = np.frombuffer(body[1:], dtype=np.uint8).astype(bool)
+        if len(results) != len(messages):
+            raise ValueError("sidecar returned wrong result count")
+        return results
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            while True:
+                req_id, body = _read_frame(sock)
+                waiter = self._pending.pop(req_id, None)
+                if waiter is not None:
+                    waiter["body"] = body
+                    waiter["event"].set()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._drop_socket(sock)
+
+    def _drop_socket(self, sock: socket.socket) -> None:
+        """Fail every in-flight request and let the next call reconnect."""
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None
+            pending, self._pending = dict(self._pending), {}
+        try:
+            sock.close()
+        except OSError:
+            pass
+        for waiter in pending.values():
+            waiter["event"].set()  # body stays None -> ConnectionError
+
+    def close(self) -> None:
+        sock = self._sock
+        if sock is not None:
+            self._drop_socket(sock)
+
+
+__all__ = [
+    "VerifySidecarServer",
+    "SidecarVerifierClient",
+    "encode_request",
+    "decode_request",
+]

@@ -9,5 +9,4 @@ from consensus_tpu.utils.blacklist import (  # noqa: F401
     compute_blacklist_update,
     prune_blacklist,
 )
-from consensus_tpu.utils.votes import VoteSet, NextViews  # noqa: F401
 from consensus_tpu.utils.digests import commit_signatures_digest  # noqa: F401

@@ -449,10 +449,11 @@ def decode_view_metadata(buf: bytes) -> ViewMetadata:
 # --- SavedMessage (WAL records) ------------------------------------------
 
 
-def _w_proposed_record(w: _Writer, m: ProposedRecord) -> None:
+def _w_proposed_record(w: _Writer, m: ProposedRecord, version: int = 2) -> None:
     _w_pre_prepare(w, m.pre_prepare)
     _w_prepare(w, m.prepare)
-    w.boolean(m.verified)
+    if version >= 2:
+        w.boolean(m.verified)
 
 
 def _r_proposed_record(r: _Reader, version: int) -> ProposedRecord:
@@ -504,16 +505,36 @@ _SAVED_CODECS: dict[int, tuple[type, Callable, Callable]] = {
 _SAVED_TAG_BY_TYPE = {cls: tag for tag, (cls, _, _) in _SAVED_CODECS.items()}
 
 
+def _saved_version_for(msg: SavedMessage) -> int:
+    """Lowest record version that expresses ``msg`` losslessly.
+
+    Records stay at v1 whenever possible (a ProposedRecord's ``verified``
+    flag defaults to True under v1 semantics, and the other three kinds are
+    unchanged since v1), so a binary ROLLBACK after an upgrade still finds
+    a WAL it can decode — the crash-recovery pin must survive downgrades,
+    not just upgrades.  Only the rare mid-verification crash window
+    (``verified=False``) needs v2, and such a record is rewritten at the
+    next truncation anyway.
+    """
+    if isinstance(msg, ProposedRecord) and not msg.verified:
+        return _SAVED_VERSION
+    return 1
+
+
 def encode_saved(msg: SavedMessage) -> bytes:
     """Serialize a WAL record."""
     tag = _SAVED_TAG_BY_TYPE.get(type(msg))
     if tag is None:
         raise CodecError(f"not a saved message: {type(msg).__name__}")
+    version = _saved_version_for(msg)
     w = _Writer()
-    w.u8(_SAVED_VERSION)
+    w.u8(version)
     w.u8(_DOMAIN_SAVED)
     w.u8(tag)
-    _SAVED_CODECS[tag][1](w, msg)
+    if isinstance(msg, ProposedRecord):
+        _w_proposed_record(w, msg, version)
+    else:
+        _SAVED_CODECS[tag][1](w, msg)
     return w.getvalue()
 
 

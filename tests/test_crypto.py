@@ -539,6 +539,106 @@ class TestThreadCoalescer:
             v.verify_batch([b"m"], [b"s"], [b"k"])
 
 
+class TestWedgedDeviceEscapeHatch:
+    """A wedged device (hung TPU tunnel) must not block the replica loop:
+    waiters fall back to the engine's host path within ``wait_timeout`` and
+    subsequent submissions skip the device queue entirely (VERDICT r3 #3)."""
+
+    class _Hung:
+        """Engine whose device path never returns (wedged tunnel) but whose
+        host path works."""
+
+        def __init__(self):
+            import threading
+
+            self.never = threading.Event()
+            self.host_calls = 0
+
+        def verify_batch(self, msgs, sigs, keys):
+            self.never.wait()  # wedged forever
+
+        def verify_host(self, msgs, sigs, keys):
+            import numpy as np
+
+            self.host_calls += 1
+            return np.array([s == b"good" for s in sigs], dtype=bool)
+
+    def test_hung_engine_falls_back_to_host_and_marks_suspect(self):
+        import time
+
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        fake = self._Hung()
+        v = ThreadCoalescingVerifier(fake, window=0.005, wait_timeout=0.15)
+        start = time.monotonic()
+        out = v.verify_batch([b"m"] * 3, [b"good", b"bad", b"good"], [b"k"] * 3)
+        first = time.monotonic() - start
+        assert list(out) == [True, False, True]
+        assert first < 5.0  # escaped the hang, did not wait forever
+        assert v.device_suspect
+        # Second call: straight to host, no wait_timeout stall.
+        start = time.monotonic()
+        out2 = v.verify_batch([b"m"], [b"good"], [b"k"])
+        assert time.monotonic() - start < 0.1
+        assert out2[0]
+        assert fake.host_calls >= 2
+        v.close()
+
+    def test_fast_device_error_is_served_by_host_fallback(self):
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        class _Flaky(self._Hung):
+            def verify_batch(self, msgs, sigs, keys):
+                raise RuntimeError("device fell over")
+
+        fake = _Flaky()
+        v = ThreadCoalescingVerifier(fake, window=0.005, wait_timeout=5.0)
+        # No exception: the flusher serves the flush from the host path.
+        out = v.verify_batch([b"m"] * 2, [b"good", b"bad"], [b"k"] * 2)
+        assert list(out) == [True, False]
+        assert v.device_suspect
+        v.close()
+
+    def test_probe_recovers_device_after_transient_failure(self):
+        import time
+
+        import numpy as np
+
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        class _Transient:
+            def __init__(self):
+                self.fail = True
+                self.device_calls = 0
+
+            def verify_batch(self, msgs, sigs, keys):
+                self.device_calls += 1
+                if self.fail:
+                    raise RuntimeError("transient device error")
+                return np.array([s == b"good" for s in sigs], dtype=bool)
+
+            def verify_host(self, msgs, sigs, keys):
+                return np.array([s == b"good" for s in sigs], dtype=bool)
+
+        fake = _Transient()
+        v = ThreadCoalescingVerifier(fake, window=0.005, wait_timeout=5.0)
+        v._probe_interval = 0.0  # probe on every suspect-mode call
+        assert list(v.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
+        assert v.device_suspect
+        fake.fail = False
+        # Suspect-mode call host-verifies AND enqueues a no-wait probe; the
+        # flusher's successful probe flush clears the flag.
+        assert list(v.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
+        deadline = time.monotonic() + 5.0
+        while v.device_suspect and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not v.device_suspect, "successful probe flush should clear suspect"
+        before = fake.device_calls
+        assert list(v.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
+        assert fake.device_calls > before  # back on the device path
+        v.close()
+
+
 class TestSharding:
     def test_sharded_matches_single_device(self):
         import jax

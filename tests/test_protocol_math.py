@@ -7,8 +7,6 @@ TestGetLeaderId:165, TestBlacklist:20).
 import pytest
 
 from consensus_tpu.utils import (
-    NextViews,
-    VoteSet,
     compute_blacklist_update,
     compute_quorum,
     get_leader_id,
@@ -130,39 +128,3 @@ class TestBlacklist:
 
     def test_prune_empty(self):
         assert prune_blacklist([], {1: [2]}, 2, self.NODES) == []
-
-
-class TestVoteSet:
-    def test_dedup_by_sender(self):
-        vs = VoteSet()
-        assert vs.register(1, "a")
-        assert not vs.register(1, "b")
-        assert vs.register(2, "c")
-        assert len(vs) == 2
-
-    def test_validity_predicate(self):
-        vs = VoteSet(valid_vote=lambda s, m: m == "ok")
-        assert not vs.register(1, "bad")
-        assert vs.register(1, "ok")
-
-    def test_clear(self):
-        vs = VoteSet()
-        vs.register(1, "a")
-        vs.clear()
-        assert len(vs) == 0
-        assert vs.register(1, "a")
-
-
-class TestNextViews:
-    def test_register_keeps_max(self):
-        nv = NextViews()
-        nv.register(3, sender=1)
-        nv.register(2, sender=1)
-        assert nv.matches(3, sender=1)
-        assert not nv.matches(2, sender=1)
-
-    def test_clear(self):
-        nv = NextViews()
-        nv.register(3, sender=1)
-        nv.clear()
-        assert not nv.matches(3, sender=1)

@@ -286,10 +286,13 @@ def test_restore_verified_record_does_not_reverify():
     assert v._curr_prepare_sent is not None
 
 
-def test_mark_proposed_verified_flips_memory_record_only():
+def test_mark_proposed_verified_upgrades_memory_and_wal_tail():
     """After the leader's deferred verification succeeds, the in-memory
-    record flips to verified (so a mid-run reseed skips the re-verify) but
-    the on-disk record keeps verified=False (crash-restore re-verifies)."""
+    record flips to verified (so a mid-run reseed skips the re-verify) AND
+    — since the unverified record is still the WAL tail — an upgraded copy
+    is appended, so a CRASH-restore skips the spurious re-verify too
+    (ADVICE r3: verifier state advancing between write and restore would
+    otherwise false-fail and depose a leader that had already verified)."""
     from consensus_tpu.wire import decode_saved
 
     wal = MemWAL([])
@@ -303,7 +306,15 @@ def test_mark_proposed_verified_flips_memory_record_only():
     assert v.reverify_calls == []  # memory copy is verified: no re-verify
     assert v._curr_prepare_sent is not None
     disk = decode_saved(wal.entries[-1])
-    assert not disk.verified  # the durable record is untouched
+    assert disk.verified  # upgraded copy appended at the tail
+
+    # Crash-restore over the upgraded WAL: no re-verification either.
+    state_reborn = PersistedState(wal, InFlightData(), entries=wal.entries)
+    v_reborn = ViewStub(self_id=1, leader_id=1)
+    state_reborn.restore(v_reborn)
+    assert v_reborn.reverify_calls == []
+    assert v_reborn.phase == Phase.PROPOSED
+    assert v_reborn._curr_prepare_sent is not None
 
     # A non-matching (view, seq) must not flip anything.
     state2 = PersistedState(MemWAL([]), InFlightData(), entries=[])
@@ -312,3 +323,24 @@ def test_mark_proposed_verified_flips_memory_record_only():
     v2 = ViewStub(number=3, proposal_sequence=9)
     state2.reseed_if_inflight_matches(v2)
     assert v2.reverify_calls  # still unverified: reseed re-verifies
+
+
+def test_mark_proposed_verified_skips_wal_upgrade_when_not_tail():
+    """The verified-upgrade append must never clobber a record that
+    followed the proposal: if anything else was saved since (here a
+    ViewChange vote), the upgrade is memory-only and the WAL tail keeps
+    its meaning for restore."""
+    from consensus_tpu.wire import decode_saved
+
+    wal = MemWAL([])
+    record = dataclasses.replace(proposed_record(view=2, seq=5), verified=False)
+    state = PersistedState(wal, InFlightData(), entries=wal.entries)
+    state.save(record)
+    state.save(SavedViewChange(view_change=ViewChange(next_view=3)))
+    state.mark_proposed_verified(2, 5)
+    tail = decode_saved(wal.entries[-1])
+    assert isinstance(tail, SavedViewChange)  # tail untouched
+    # Memory copy still flipped: mid-run reseeds skip the re-verify.
+    v = ViewStub(number=2, proposal_sequence=5)
+    state.reseed_if_inflight_matches(v)
+    assert v.reverify_calls == []

@@ -6,7 +6,6 @@ Parity model: the reference's randomized/long-running scenarios in
 test/basic_test.go, compressed into deterministic virtual time.
 """
 
-import os
 import random
 
 import pytest
@@ -28,13 +27,10 @@ def test_randomized_fault_soak(seed):
     _run_soak(seed)
 
 
-#: Opt-in wide sweep (40 seeds total with the CI four): the dev-loop gate
-#: for protocol changes.  CI pins 4 seeds; run the sweep locally with
-#: ``CTPU_SOAK=1 python -m pytest tests/test_soak.py -q``.
-@pytest.mark.skipif(
-    os.environ.get("CTPU_SOAK") != "1",
-    reason="wide soak sweep is opt-in: set CTPU_SOAK=1",
-)
+#: Wide sweep, gated unconditionally (VERDICT r3 #6): at ~0.2 s/run the
+#: whole 85-run file stays under 20 s, so the load-bearing "many seeds,
+#: zero failures" claim is reproducible by plain ``pytest tests/test_soak.py``
+#: — not archaeology in commit messages.
 @pytest.mark.parametrize("seed", list(range(100, 136)))
 def test_randomized_fault_soak_sweep(seed):
     _run_soak(seed)
@@ -249,10 +245,6 @@ def test_targeted_message_chaos(seed, n):
     _run_targeted_chaos(seed, n)
 
 
-@pytest.mark.skipif(
-    os.environ.get("CTPU_SOAK") != "1",
-    reason="wide chaos sweep is opt-in: set CTPU_SOAK=1",
-)
 @pytest.mark.parametrize("seed", list(range(200, 220)))
 @pytest.mark.parametrize("n", [4, 7])
 def test_targeted_message_chaos_sweep(seed, n):

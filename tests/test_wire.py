@@ -221,20 +221,46 @@ def test_saved_round_trip_unverified_record():
         prepare=Prepare(view=1, seq=2, digest=PROPOSAL.digest()),
         verified=False,
     )
-    out = wire.decode_saved(wire.encode_saved(rec))
+    buf = wire.encode_saved(rec)
+    assert buf[0] == 2  # verified=False is only expressible in v2
+    out = wire.decode_saved(buf)
     assert out == rec and out.verified is False
+
+
+def test_saved_verified_record_encodes_as_v1_for_rollback():
+    """Records losslessly expressible in v1 are WRITTEN as v1 (ADVICE r3:
+    a binary rollback after an upgrade must still find a decodable WAL —
+    the crash-recovery pin has to survive downgrades).  verified=True is
+    exactly v1's implicit semantics, so only the rare verified=False
+    record pays the one-way v2 format."""
+    rec = ProposedRecord(
+        pre_prepare=PrePrepare(view=1, seq=2, proposal=PROPOSAL),
+        prepare=Prepare(view=1, seq=2, digest=PROPOSAL.digest()),
+    )
+    assert rec.verified
+    buf = wire.encode_saved(rec)
+    assert buf[0] == 1  # rollback-compatible encoding
+    out = wire.decode_saved(buf)
+    assert out == rec and out.verified is True
+    # The other record kinds are unchanged since v1 and stay there too.
+    from consensus_tpu.wire import SavedNewView, ViewMetadata
+
+    nv = SavedNewView(view_metadata=ViewMetadata(view_id=3, latest_sequence=9))
+    assert wire.encode_saved(nv)[0] == 1
+    assert wire.decode_saved(wire.encode_saved(nv)) == nv
 
 
 def test_saved_v1_proposed_record_decodes_as_verified():
     """A version-1 ProposedRecord (written before the `verified` flag
     existed) has no trailing boolean; it was only ever persisted after
     verification succeeded, so decoding must yield verified=True."""
-    rec = ProposedRecord(
+    unverified = ProposedRecord(
         pre_prepare=PrePrepare(view=1, seq=2, proposal=PROPOSAL),
         prepare=Prepare(view=1, seq=2, digest=PROPOSAL.digest()),
+        verified=False,
     )
-    buf = wire.encode_saved(rec)
-    assert buf[0] == 2  # current saved-domain version
+    buf = wire.encode_saved(unverified)  # v2: trailing verified byte
     v1 = bytes([1]) + buf[1:-1]  # version byte 1, trailing verified byte gone
     out = wire.decode_saved(v1)
-    assert out == rec and out.verified is True
+    assert out.verified is True
+    assert out.pre_prepare == unverified.pre_prepare
