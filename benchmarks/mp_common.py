@@ -20,48 +20,35 @@ def _seed32(tag: bytes, i: int) -> bytes:
     return hashlib.sha256(tag % i).digest()
 
 
-def make_node_signer(family: str, node_id: int):
+def _make_signer(family: str, signer_id: int, seed: bytes):
     if family == "ed25519":
         from consensus_tpu.models import Ed25519Signer
 
-        return Ed25519Signer(node_id, private_key_bytes=_seed32(_NODE_TAG, node_id))
+        return Ed25519Signer(signer_id, private_key_bytes=seed)
     from cryptography.hazmat.primitives.asymmetric import ec
 
     from consensus_tpu.models import EcdsaP256Signer
     from consensus_tpu.models.ecdsa_p256 import N
 
-    scalar = 1 + int.from_bytes(_seed32(_NODE_TAG, node_id), "big") % (N - 1)
+    scalar = 1 + int.from_bytes(seed, "big") % (N - 1)
     return EcdsaP256Signer(
-        node_id, private_key=ec.derive_private_key(scalar, ec.SECP256R1())
+        signer_id, private_key=ec.derive_private_key(scalar, ec.SECP256R1())
     )
+
+
+def make_node_signer(family: str, node_id: int):
+    return _make_signer(family, node_id, _seed32(_NODE_TAG, node_id))
 
 
 def make_client_keyring(family: str, n_clients: int):
     from consensus_tpu.testing.crypto_app import ClientKeyring
 
-    if family == "ed25519":
-        from consensus_tpu.models import Ed25519Signer
-
-        signers = [
-            Ed25519Signer(10_000 + i, private_key_bytes=_seed32(_CLIENT_TAG, i))
+    return ClientKeyring(
+        [
+            _make_signer(family, 10_000 + i, _seed32(_CLIENT_TAG, i))
             for i in range(n_clients)
         ]
-    else:
-        from cryptography.hazmat.primitives.asymmetric import ec
-
-        from consensus_tpu.models import EcdsaP256Signer
-        from consensus_tpu.models.ecdsa_p256 import N
-
-        signers = []
-        for i in range(n_clients):
-            scalar = 1 + int.from_bytes(_seed32(_CLIENT_TAG, i), "big") % (N - 1)
-            signers.append(
-                EcdsaP256Signer(
-                    10_000 + i,
-                    private_key=ec.derive_private_key(scalar, ec.SECP256R1()),
-                )
-            )
-    return ClientKeyring(signers)
+    )
 
 
 def make_raw_engine(family: str, *, min_device_batch: int, pad_to: int = 0):

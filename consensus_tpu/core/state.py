@@ -115,6 +115,11 @@ class PersistedState:
                     self._mem_proposed, self._mem_commit = prev, last
             elif isinstance(last, ProposedRecord):
                 self._mem_proposed = last
+                # The restored tail counts as "last written" so a restore-
+                # time re-verification success upgrades the on-disk record
+                # too — without this, only the FIRST crash is protected and
+                # a second crash re-runs the spurious re-verify.
+                self._last_written = last
         except Exception:
             # A torn/corrupt tail must not fail boot here: restore() has
             # its own tolerant handling ("starting clean"), and with no

@@ -34,6 +34,7 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Optional, Sequence, Union
 
 import numpy as np
@@ -106,7 +107,6 @@ class VerifySidecarServer:
         self._address = address
         self._engine = engine
         self._listener: Optional[socket.socket] = None
-        self._threads: list[threading.Thread] = []
         self._stopping = False
 
     @property
@@ -129,11 +129,9 @@ class VerifySidecarServer:
             self._address = listener.getsockname()
         listener.listen(64)
         self._listener = listener
-        thread = threading.Thread(
+        threading.Thread(
             target=self._accept_loop, daemon=True, name="sidecar-accept"
-        )
-        thread.start()
-        self._threads.append(thread)
+        ).start()
 
     def stop(self) -> None:
         self._stopping = True
@@ -154,14 +152,15 @@ class VerifySidecarServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
-                if conn.family == socket.AF_INET else None
-            thread = threading.Thread(
+            if conn.family == socket.AF_INET:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Daemon threads, deliberately untracked: connections churn for
+            # the life of the sidecar and holding dead Thread objects would
+            # grow without bound; stop() only needs the listener.
+            threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True,
                 name="sidecar-conn",
-            )
-            thread.start()
-            self._threads.append(thread)
+            ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         write_lock = threading.Lock()
@@ -224,17 +223,24 @@ class SidecarVerifierClient:
         request_timeout: float = 60.0,
         connect_timeout: float = 5.0,
         bypass_below: int = 0,
+        probe_interval: float = 10.0,
     ) -> None:
         self._address = address
         self._timeout = request_timeout
         self._connect_timeout = connect_timeout
         self._local = local_engine
         self._bypass_below = bypass_below if local_engine is not None else 0
+        self._probe_interval = probe_interval
         self._lock = threading.Lock()  # guards socket create + sends
         self._sock: Optional[socket.socket] = None
         self._pending: dict[int, dict] = {}
         self._next_id = 0
         self._reader: Optional[threading.Thread] = None
+        #: Set after a request TIMES OUT (sidecar wedged, not just dead):
+        #: later calls skip the stall and go straight to the local fallback
+        #: while a background probe watches for recovery.
+        self._suspect = False
+        self._closed = False
 
     # -- engine contract ---------------------------------------------------
 
@@ -244,15 +250,23 @@ class SidecarVerifierClient:
             raise ValueError("batch length mismatch")
         if n == 0:
             return np.zeros(0, dtype=bool)
+        if self._suspect and self._local is not None:
+            # Wedged sidecar: don't stall request_timeout on every call —
+            # the background probe clears the flag when it recovers.
+            return np.asarray(
+                self._local.verify_host(messages, signatures, public_keys)
+            )
         if n < self._bypass_below:
             return np.asarray(
                 self._local.verify_host(messages, signatures, public_keys)
             )
         try:
-            return self._roundtrip(messages, signatures, public_keys)
+            result = self._roundtrip(messages, signatures, public_keys)
         except Exception as exc:
             if self._local is None:
                 raise
+            if isinstance(exc, TimeoutError):
+                self._mark_suspect()
             logger.error(
                 "sidecar verify failed (%r) — falling back to LOCAL host "
                 "verification for %d signatures",
@@ -262,6 +276,49 @@ class SidecarVerifierClient:
             return np.asarray(
                 self._local.verify_host(messages, signatures, public_keys)
             )
+        return result
+
+    def _mark_suspect(self) -> None:
+        """A timed-out request means the sidecar is wedged (its device call
+        hung), not merely dead: drop the socket so other in-flight waiters
+        fail over immediately, and probe for recovery in the background."""
+        with self._lock:
+            if self._suspect or self._closed:
+                already = True
+            else:
+                self._suspect = True
+                already = False
+            sock = self._sock
+        if already:
+            return
+        logger.error(
+            "sidecar did not answer within %.1fs — marking it suspect; "
+            "verification continues on the LOCAL host path until a probe "
+            "succeeds",
+            self._timeout,
+        )
+        if sock is not None:
+            self._drop_socket(sock)
+        threading.Thread(
+            target=self._probe_loop, daemon=True, name="sidecar-probe"
+        ).start()
+
+    def _probe_loop(self) -> None:
+        while True:
+            time.sleep(self._probe_interval)
+            with self._lock:
+                if self._closed or not self._suspect:
+                    return
+            try:
+                # An empty batch exercises the full socket + server + engine
+                # dispatch path cheaply.
+                self._roundtrip([], [], [], timeout=self._probe_interval)
+            except Exception:
+                continue
+            with self._lock:
+                self._suspect = False
+            logger.warning("sidecar recovered — resuming sidecar verification")
+            return
 
     def verify_host(self, messages, signatures, public_keys) -> np.ndarray:
         """Escape-hatch seam (used if this client is itself wrapped in a
@@ -297,7 +354,9 @@ class SidecarVerifierClient:
         self._reader.start()
         return sock
 
-    def _roundtrip(self, messages, signatures, keys) -> np.ndarray:
+    def _roundtrip(
+        self, messages, signatures, keys, *, timeout: Optional[float] = None
+    ) -> np.ndarray:
         payload = encode_request(messages, signatures, keys)
         waiter = {"event": threading.Event(), "body": None}
         send_error: Optional[OSError] = None
@@ -316,7 +375,7 @@ class SidecarVerifierClient:
             # while held would self-deadlock and wedge every verify).
             self._drop_socket(sock)
             raise send_error
-        if not waiter["event"].wait(self._timeout):
+        if not waiter["event"].wait(timeout if timeout is not None else self._timeout):
             self._pending.pop(req_id, None)
             raise TimeoutError(
                 f"sidecar did not answer within {self._timeout}s"
@@ -358,6 +417,8 @@ class SidecarVerifierClient:
             waiter["event"].set()  # body stays None -> ConnectionError
 
     def close(self) -> None:
+        with self._lock:
+            self._closed = True
         sock = self._sock
         if sock is not None:
             self._drop_socket(sock)

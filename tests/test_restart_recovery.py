@@ -344,3 +344,33 @@ def test_mark_proposed_verified_skips_wal_upgrade_when_not_tail():
     v = ViewStub(number=2, proposal_sequence=5)
     state.reseed_if_inflight_matches(v)
     assert v.reverify_calls == []
+
+
+def test_restore_time_reverify_upgrades_wal_for_second_crash():
+    """Crash #1 restores an unverified tail and re-verifies successfully:
+    that success must be persisted (upgraded tail record) so crash #2 does
+    NOT re-verify again — double-crash protection for the ADVICE-r3 fix
+    (without seeding _last_written from the restored tail, only mid-run
+    verification successes were upgraded on disk)."""
+    wal = MemWAL([])
+    record = dataclasses.replace(proposed_record(view=2, seq=5), verified=False)
+    wal.append(encode_saved(record), truncate_to=True)
+
+    # Crash #1: restore re-verifies (verified=False tail) and succeeds.
+    state1 = PersistedState(wal, InFlightData(), entries=list(wal.entries))
+    v1 = ViewStub(self_id=1, leader_id=1)
+    state1.restore(v1)
+    assert v1.reverify_calls, "premise: first restore re-verifies"
+
+    from consensus_tpu.wire import decode_saved
+
+    assert decode_saved(wal.entries[-1]).verified, (
+        "restore-time verification success was not persisted"
+    )
+
+    # Crash #2: the upgraded tail restores with NO re-verification.
+    state2 = PersistedState(wal, InFlightData(), entries=list(wal.entries))
+    v2 = ViewStub(self_id=1, leader_id=1)
+    state2.restore(v2)
+    assert v2.reverify_calls == []
+    assert v2.phase == Phase.PROPOSED

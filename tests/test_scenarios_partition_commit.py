@@ -336,3 +336,87 @@ def test_sync_restart_of_current_view_cannot_equivocate():
         )
     cluster.assert_ledgers_consistent()
     _assert_no_double_delivery(cluster)
+
+
+def test_leader_partitioned_before_first_decision():
+    """The INITIAL leader is partitioned (alive, not crashed) before
+    anything commits: the rest must view-change away from it and order,
+    while the isolated leader commits nothing.  Parity: basic_test.go:215
+    (TestLeaderInPartition — the pre-decision variant; the post-decision
+    one is test_leader_partitioned_after_decision_heals_and_syncs)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.network.partition([1])
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, node_ids=[2, 3, 4], max_time=900.0), (
+        "survivors failed to depose the partitioned initial leader"
+    )
+    assert len(cluster.nodes[1].app.ledger) == 0
+    assert cluster.nodes[2].consensus.controller.curr_view_number >= 1
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)
+
+
+def test_lone_prepared_leader_partitioned_then_heals():
+    """Only the LEADER reaches PREPARED for a request (follower-bound
+    prepares are all dropped, so followers stay in PROPOSED); the leader is
+    then partitioned away.  The survivors' view change must NOT resurrect
+    the leader-only in-flight (condition B: no f+1 report it prepared) —
+    they order the next request instead; on heal the ex-leader abandons its
+    prepared-but-uncommitted state via sync, and a SECOND view change (new
+    leader partitioned) completes with the ex-leader participating.
+    Parity: basic_test.go:2386
+    (TestNodePreparesTheRestInPartitionThenPartitionHeals)."""
+    from consensus_tpu.wire import Prepare
+
+    cluster = Cluster(4, config_tweaks=FAST)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    # Withhold every prepare addressed to a non-leader: only node 1 can
+    # assemble a prepare quorum for the next request.
+    def drop_prepares_to_followers(sender, target, msg):
+        if isinstance(msg, Prepare) and target != 1:
+            return None
+        return msg
+
+    cluster.network.mutate_send = drop_prepares_to_followers
+    cluster.nodes[1].submit(make_request("c", 1))  # leader-only request
+    cluster.scheduler.advance(6.0)  # leader prepares + broadcasts commit
+
+    # Premise check: the leader ALONE reached PREPARED; nobody committed.
+    from consensus_tpu.core.view import Phase
+
+    assert cluster.nodes[1].consensus.controller.curr_view.phase == Phase.PREPARED
+    assert all(len(n.app.ledger) == 1 for n in cluster.nodes.values())
+
+    cluster.network.partition([1])  # leader alone, PREPARED at seq 2
+    cluster.network.mutate_send = None
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(2, node_ids=[2, 3, 4], max_time=900.0), (
+        "survivors failed to move past the leader-only prepared proposal"
+    )
+
+    cluster.network.heal()
+    cluster.scheduler.advance(120.0)  # ex-leader detects + syncs
+    assert cluster.scheduler.run_until(
+        lambda: len(cluster.nodes[1].app.ledger) >= 2, max_time=900.0
+    ), "healed ex-leader did not adopt the survivors' chain"
+
+    # Second view change: partition the CURRENT leader; the ex-leader must
+    # participate in the quorum that replaces it.
+    curr_view = cluster.nodes[2].consensus.controller.curr_view_number
+    curr_leader = cluster.nodes[2].consensus.get_leader_id()
+    assert curr_leader != 1
+    cluster.network.partition([curr_leader])
+    survivors = [i for i in cluster.nodes if i != curr_leader]
+    cluster.submit_to_all(make_request("c", 3))
+    target = len(cluster.nodes[2].app.ledger) + 1
+    assert cluster.run_until_ledger(
+        target, node_ids=survivors, max_time=900.0
+    ), "second view change (with the healed ex-leader) failed"
+    cluster.network.heal()
+    cluster.scheduler.advance(60.0)
+    cluster.assert_ledgers_consistent()
+    _assert_no_double_delivery(cluster)

@@ -236,3 +236,58 @@ def test_rotation_storm_n10():
     # Rotation actually rotated: every decision under a different sequence
     # of leaders; all ten replicas converged to the same 25 blocks.
     assert all(len(n.app.ledger) == 25 for n in cluster.nodes.values())
+
+
+def test_grow_then_shrink_membership_one_by_one():
+    """Grow the cluster 4 -> 7 in one reconfiguration (new nodes boot after
+    the decision and must sync the whole history), order through the larger
+    quorum, then REMOVE three nodes one at a time — each removal a separate
+    reconfiguration, the removed node going dark right after — ending at
+    n=4 with a working quorum.  Parity: reference test/reconfig_test.go:231
+    (TestAddRemoveNodes: 4 -> 10 grow, then remove 4 one by one; compressed
+    here to keep sim time bounded while preserving the sequential-removal
+    structure that distinguishes it from test_add_remove_add_cycle)."""
+    cluster = Cluster(4, config_tweaks=FAST)
+    install_reconfig_hook(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    # --- grow to 7 in one decision ---------------------------------------
+    cluster.submit_to_all(reconfig_request("grow", [1, 2, 3, 4, 5, 6, 7]))
+    assert cluster.run_until_ledger(2, node_ids=[1, 2, 3, 4], max_time=600.0)
+    cluster.scheduler.advance(5.0)
+    for node_id in (5, 6, 7):
+        _boot_node(cluster, node_id)
+    cluster.scheduler.advance(150.0)  # joiners detect the gap and sync
+    for node_id in (5, 6, 7):
+        assert len(cluster.nodes[node_id].app.ledger) >= 2, (
+            f"joiner {node_id} did not sync history"
+        )
+
+    # Order through the larger quorum (n=7 needs 5 — the joiners count).
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(3, max_time=600.0)
+
+    # --- shrink back to 4, one removal per decision ----------------------
+    members = [1, 2, 3, 4, 5, 6, 7]
+    for victim in (5, 6, 7):
+        members = [m for m in members if m != victim]
+        cluster.submit_to_all(reconfig_request(f"rm{victim}", members))
+        target = len(cluster.nodes[1].app.ledger) + 1
+        assert cluster.run_until_ledger(
+            target, node_ids=members, max_time=900.0
+        ), f"removal of {victim} did not commit"
+        cluster.scheduler.advance(30.0)
+        node = cluster.nodes[victim]
+        assert node.consensus is None or not node.consensus._running, (
+            f"evicted node {victim} did not shut down"
+        )
+        node.running = False
+
+    cluster.submit_to_all(make_request("c", 2))
+    target = len(cluster.nodes[1].app.ledger) + 1
+    assert cluster.run_until_ledger(
+        target, node_ids=[1, 2, 3, 4], max_time=600.0
+    ), "shrunk cluster (back at n=4) failed to order"
+    cluster.assert_ledgers_consistent()

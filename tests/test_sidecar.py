@@ -243,3 +243,53 @@ def test_send_failure_falls_back_without_deadlock(monkeypatch):
     finally:
         client.close()
         server.stop()
+
+
+def test_wedged_sidecar_marks_suspect_and_probes_back():
+    """A TIMED-OUT request (wedged sidecar, hung device call) must not cost
+    every later call the full request_timeout: the client marks the sidecar
+    suspect, answers from the local engine immediately, and a background
+    probe restores sidecar mode once it answers again."""
+    import time
+
+    gate = threading.Event()
+
+    class Gated:
+        """Blocks until the gate opens (wedged), then serves normally."""
+
+        def verify_batch(self, m, s, k):
+            if not gate.wait(timeout=30.0):
+                raise RuntimeError("gate never opened")
+            return np.array([x == b"good" for x in s], dtype=bool)
+
+    local = FakeEngine()
+    server = VerifySidecarServer(("127.0.0.1", 0), Gated())
+    server.start()
+    client = SidecarVerifierClient(
+        server.address, local_engine=local, request_timeout=0.3,
+        probe_interval=0.05,
+    )
+    try:
+        # First call: stalls request_timeout, falls back, marks suspect.
+        out = client.verify_batch([b"m"], [b"good"], [b"k"])
+        assert list(out) == [True]
+        assert client._suspect
+
+        # Later calls answer locally with NO timeout stall.
+        start = time.monotonic()
+        out = client.verify_batch([b"m"], [b"bad"], [b"k"])
+        assert time.monotonic() - start < 0.2
+        assert list(out) == [False]
+
+        # Unwedge the server: the probe clears the flag and sidecar mode
+        # resumes.
+        gate.set()
+        deadline = time.monotonic() + 5.0
+        while client._suspect and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not client._suspect, "probe never cleared the suspect flag"
+        out = client.verify_batch([b"m"], [b"good"], [b"k"])
+        assert list(out) == [True]
+    finally:
+        client.close()
+        server.stop()
