@@ -1,0 +1,66 @@
+#!/bin/bash
+# One-shot device measurement suite (VERDICT r3 #1/#2/#4): run EVERYTHING
+# that needs the live TPU tunnel, in priority order, appending JSON lines
+# (stamped with commit + UTC time) to benchmarks/device_results.jsonl.
+# Safe to re-run; each section tolerates individual failures.
+#
+#   bash benchmarks/run_device_suite.sh [quick]
+#
+# "quick" runs only the raw-engine bench + config 3 (the round gate's
+# minimum) for short tunnel windows.
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/device_results.jsonl
+COMMIT=$(git rev-parse --short HEAD)
+note() { echo "# $*" >&2; }
+record() {  # record <label> <cmd...>  — runs cmd, tags its JSON line
+  local label=$1; shift
+  note "=== $label ==="
+  local line stamp
+  line=$("$@" 2>>benchmarks/device_suite.log | grep -m1 '^{')
+  stamp=$(date -u +%Y-%m-%dT%H:%M:%SZ)  # per-measurement, not suite-start
+  if [ -n "$line" ]; then
+    echo "${line%\}}, \"label\": \"$label\", \"commit\": \"$COMMIT\", \"utc\": \"$stamp\"}" >> "$OUT"
+    echo "$line"
+  else
+    note "$label produced no JSON (see benchmarks/device_suite.log)"
+  fi
+}
+
+# Priority 1: the driver artifact metric (raw engine, both families).
+record bench_ed25519 timeout 1200 python bench.py
+record bench_p256    timeout 1200 python bench.py p256
+
+# Priority 2: device-mode integrated columns at HEAD (in-process coalesced)
+# against the post-reorder host rows (config 3 bar: 999 tx/s / 97 ms p50).
+record cfg3_device timeout 900 python benchmarks/chain_crypto_tps.py \
+  --family ed25519 --n 7 --batch 1000 --verify device --seconds 15
+
+if [ "${1:-}" = "quick" ]; then exit 0; fi
+
+record north_device timeout 900 python benchmarks/chain_crypto_tps.py \
+  --family ed25519 --n 10 --batch 1000 --rotate 100 --verify device --seconds 15
+record cfg2_device timeout 900 python benchmarks/chain_crypto_tps.py \
+  --family p256 --n 4 --batch 500 --verify device --seconds 15
+record cfg4_device timeout 900 python benchmarks/chain_crypto_tps.py \
+  --family p256 --n 10 --batch 100 --rotate 100 --verify device --seconds 15
+
+# Priority 3: the deployment-shaped number — n processes, one TPU sidecar.
+record mp_cfg3_device timeout 1200 python benchmarks/chain_crypto_mp.py \
+  --family ed25519 --n 7 --batch 1000 --verify device --seconds 15
+record mp_north_device timeout 1200 python benchmarks/chain_crypto_mp.py \
+  --family ed25519 --n 10 --batch 1000 --rotate 100 --verify device --seconds 15
+
+# Priority 4: the MXU lowering A/B on the real device.
+note "=== mxu_fieldmul (3 lines) ==="
+timeout 1200 python benchmarks/mxu_fieldmul.py --batch 8192 --iters 30 \
+  2>>benchmarks/device_suite.log | while read -r line; do
+    case "$line" in
+      {*) stamp=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+          echo "${line%\}}, \"commit\": \"$COMMIT\", \"utc\": \"$stamp\"}" >> "$OUT"
+          echo "$line" ;;
+    esac
+  done
+
+note "device suite done -> $OUT"

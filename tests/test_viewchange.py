@@ -306,6 +306,7 @@ class _VCStubController:
     def __init__(self):
         self.aborted = []
         self.changed = []
+        self.delivered = []
         self.synced = 0
 
     def abort_view(self, view):
@@ -320,6 +321,7 @@ class _VCStubController:
     def deliver(self, proposal, signatures):
         from consensus_tpu.types import Reconfig
 
+        self.delivered.append((proposal, tuple(signatures)))
         return Reconfig()
 
     def maybe_prune_revoked_requests(self):
@@ -678,4 +680,194 @@ class TestAdversarialViewChangeInputs:
         vc._process_new_view(nv)
         assert controller.changed, "quorum NewView must install the view"
         assert vc.real_view == 1
+        vc.stop()
+
+
+class TestViewDataLastDecisionPaths:
+    """The new leader's last-decision walk inside ViewData validation —
+    behind / equal / one-ahead / far-ahead senders.  Parity: reference
+    viewchanger.go:535-666 via viewchanger_test.go (TestCommitLastDecision
+    :1133, the "greater last decision sequence", "last decision not equal"
+    and "nil last decision" rows of TestBadViewDataMessage:479)."""
+
+    def _signed_vd(self, signer, data):
+        from consensus_tpu.wire import SignedViewData, encode_view_data
+
+        return SignedViewData(
+            signer=signer,
+            raw_view_data=encode_view_data(data),
+            signature=b"sig-%d" % signer,
+        )
+
+    def _collecting_vc(self):
+        from consensus_tpu.wire import ViewChange as VC
+
+        vc, sched, comm, controller, timer = _make_vc()
+        vc.start(0)
+        for sender in (1, 3, 4):
+            vc.handle_message(sender, VC(next_view=1))
+        sched.advance(0.1)
+        return vc, sched, comm, controller
+
+    def _sigs(self, ids):
+        return tuple(Signature(id=i, value=b"sig-%d" % i) for i in ids)
+
+    def test_one_ahead_last_decision_is_delivered_then_counted(self):
+        """A sender exactly one decision ahead: the new leader validates the
+        carried quorum, DELIVERS that decision itself, and the vote counts.
+        Parity: reference TestCommitLastDecision (viewchanger_test.go:1133)."""
+        vc, sched, comm, controller = self._collecting_vc()
+        decision = proposal_at(1)  # our checkpoint is genesis (seq 0)
+        data = ViewData(
+            next_view=1,
+            last_decision=decision,
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc.handle_message(3, self._signed_vd(3, data))
+        assert controller.delivered, "one-ahead decision was not delivered"
+        assert controller.delivered[0][0] == decision
+        assert vc._view_data_votes.get(3) is not None, "vote did not count"
+        vc.stop()
+
+    def test_far_ahead_last_decision_rejected_without_delivery(self):
+        """More than one ahead: this leader may lack the config to validate
+        the gap — the vote is rejected and NOTHING is delivered (liveness
+        comes from the view-change timeout's sync path, a documented
+        deviation from the reference's immediate Sync call)."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(2),  # two ahead of genesis
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc.handle_message(3, self._signed_vd(3, data))
+        assert not controller.delivered
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_one_ahead_with_invalid_quorum_not_delivered(self):
+        """One ahead but the carried signature set does not form a valid
+        quorum: the decision must NOT be delivered (a forged 'ahead'
+        ViewData would otherwise inject a block)."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(1),
+            last_decision_signatures=self._sigs([1, 3]),  # quorum-1
+        )
+        vc.handle_message(3, self._signed_vd(3, data))
+        assert not controller.delivered
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_nil_last_decision_rejected(self):
+        vc, sched, comm, controller = self._collecting_vc()
+        data = ViewData(next_view=1, last_decision=None)
+        vc.handle_message(3, self._signed_vd(3, data))
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_same_seq_different_decision_rejected(self):
+        """Equal sequence but a DIFFERENT decision than ours: reject (one of
+        us is provably wrong; counting the vote could seed a fork)."""
+        vc, sched, comm, controller = self._collecting_vc()
+        mine = proposal_at(3, payload=b"mine")
+        vc._checkpoint.set(mine, [])
+        theirs = proposal_at(3, payload=b"theirs")
+        data = ViewData(
+            next_view=1,
+            last_decision=theirs,
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc.handle_message(3, self._signed_vd(3, data))
+        assert vc._view_data_votes.get(3) is None
+        # And the matching decision DOES count.
+        data_ok = ViewData(
+            next_view=1,
+            last_decision=mine,
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc.handle_message(3, self._signed_vd(3, data_ok))
+        assert vc._view_data_votes.get(3) is not None
+        vc.stop()
+
+
+class TestViewChangeTimeoutBackoff:
+    """Timeout escalation with exponential backoff + resend liveness aids.
+    Parity: reference viewchanger_test.go (TestViewChangerTimeout:1009,
+    TestBackOff:1067, TestResendViewChangeMessage:954)."""
+
+    def test_timeout_syncs_escalates_and_backs_off(self):
+        from consensus_tpu.wire import ViewChange as VC
+
+        vc, sched, comm, controller, timer = _make_vc()
+        vc.start(0)
+        vc.start_view_change(0, stop_view=True)  # nobody joins: stalls
+        assert vc._check_timeout and vc._backoff_factor == 1
+        start_broadcasts = len(comm.broadcasts)
+
+        sched.advance(vc._vc_timeout + 1.5)  # first timeout window
+        assert controller.synced == 1, "timeout must trigger a sync"
+        assert vc._backoff_factor == 2, "backoff factor must grow"
+        # The timeout RE-REQUESTS the same next view (escalating the target
+        # is the f+1 jump rule's job, as in the reference); the restarted
+        # change broadcast a fresh ViewChange vote.
+        assert vc.next_view == 1
+        assert len(comm.broadcasts) > start_broadcasts
+
+        # The next deadline is start + timeout * backoff measured from the
+        # ORIGINAL start (the already-changing branch deliberately keeps the
+        # clock — reference viewchanger.go:370-372), so deadlines land at
+        # t0+T, t0+2T, ...: half the doubled window must NOT fire it...
+        sched.advance(vc._vc_timeout * 0.4)
+        assert controller.synced == 1, "backoff window fired too early"
+        # ...but reaching t0 + 2T does.
+        sched.advance(vc._vc_timeout * 0.7)
+        assert controller.synced == 2
+        assert vc._backoff_factor == 3
+        vc.stop()
+
+    def test_resend_rebroadcasts_pending_vote(self):
+        from consensus_tpu.wire import ViewChange as VC
+
+        vc, sched, comm, controller, timer = _make_vc()
+        vc.start(0)
+        vc.start_view_change(0, stop_view=True)
+        votes_before = sum(
+            1 for m in comm.broadcasts
+            if isinstance(m, VC) and m.next_view == 1
+        )
+        sched.advance(vc._resend_timeout + 1.5)  # below the vc timeout
+        votes_after = sum(
+            1 for m in comm.broadcasts
+            if isinstance(m, VC) and m.next_view == 1
+        )
+        assert votes_after > votes_before, "pending vote was not re-sent"
+        vc.stop()
+
+    def test_successful_change_resets_backoff(self):
+        from consensus_tpu.wire import NewView, SignedViewData, ViewChange as VC, encode_view_data
+
+        vc, sched, comm, controller, timer = _make_vc()
+        vc.start(0)
+        vc.start_view_change(0, stop_view=True)
+        sched.advance(vc._vc_timeout + 1.5)  # one escalation
+        assert vc._backoff_factor == 2
+
+        # Now let the change to view 2 complete: quorum of votes, then the
+        # NewView from leader 3 (view 2 % 4 -> node 3).
+        for sender in (1, 3, 4):
+            vc.handle_message(sender, VC(next_view=2))
+        data = ViewData(next_view=2, last_decision=Proposal())
+        nv = NewView(signed_view_data=tuple(
+            SignedViewData(
+                signer=s,
+                raw_view_data=encode_view_data(data),
+                signature=b"sig-%d" % s,
+            )
+            for s in (1, 3, 4)
+        ))
+        vc._process_new_view(nv)
+        assert controller.changed, "view change did not complete"
+        assert vc._backoff_factor == 1, "completion must reset the backoff"
         vc.stop()
