@@ -58,7 +58,33 @@ def main() -> None:
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--warmup", type=float, default=4.0)
     ap.add_argument("--presign", type=int, default=60000)
+    ap.add_argument(
+        "--wal",
+        choices=["mem", "disk", "disk-group"],
+        default="mem",
+        help="mem: in-memory WAL (no fsync); disk: real segmented WAL with "
+        "fsync per append (the reference's 2-fsyncs-per-decision shape, "
+        "reference internal/bft/view.go:412,508); disk-group: fsyncs "
+        "amortized over a 2ms group-commit window",
+    )
+    ap.add_argument(
+        "--wal-base",
+        default="",
+        help="directory to create per-replica WALs under (the orchestrator "
+        "owns and removes it; replicas exit via SIGKILL and cannot clean "
+        "up themselves)",
+    )
     args = ap.parse_args()
+
+    if os.environ.get("CTPU_MP_DEBUG"):
+        import logging
+
+        logging.basicConfig(
+            level=logging.DEBUG,
+            stream=sys.stderr,
+            format=f"[n{args.node_id}] %(name)s %(levelname)s %(message)s",
+        )
+        logging.getLogger("consensus_tpu.net").setLevel(logging.INFO)
 
     from benchmarks.mp_common import (
         make_client_keyring,
@@ -120,6 +146,24 @@ def main() -> None:
     comm = TcpComm(args.node_id, addrs, route, reconnect_backoff=0.05)
     comm.start()
 
+    if args.wal == "mem":
+        wal = MemWAL([])
+    else:
+        import tempfile
+
+        from consensus_tpu.wal.log import WriteAheadLog
+
+        if args.wal_base:
+            wal_dir = os.path.join(args.wal_base, f"wal-{args.node_id}")
+        else:
+            wal_dir = tempfile.mkdtemp(prefix=f"ctpu-wal-{args.node_id}-")
+        wal_kw = (
+            dict(group_commit_window=0.002, scheduler=rt)
+            if args.wal == "disk-group"
+            else {}
+        )
+        wal = WriteAheadLog.create(wal_dir, **wal_kw)
+
     provider = InMemoryProvider()
     consensus = Consensus(
         config=Configuration(
@@ -134,7 +178,7 @@ def main() -> None:
         comm=comm,
         application=app,
         assembler=app,
-        wal=MemWAL([]),
+        wal=wal,
         signer=app,
         verifier=app,
         request_inspector=app.inspector,

@@ -54,6 +54,13 @@ def main() -> None:
     ap.add_argument("--presign", type=int, default=60000)
     ap.add_argument("--window", type=float, default=0.010)
     ap.add_argument(
+        "--wal",
+        choices=["mem", "disk", "disk-group"],
+        default="mem",
+        help="replica WAL mode (disk = fsync per append, the reference's "
+        "2-fsyncs-per-decision shape; disk-group = 2ms group commit)",
+    )
+    ap.add_argument(
         "--platform",
         default=None,
         help="jax platform pin for the SIDECAR (e.g. cpu for a smoke run); "
@@ -66,6 +73,9 @@ def main() -> None:
     procs: list[subprocess.Popen] = []
     sidecar_proc = None
     sidecar_path = ""
+    wal_base = ""
+    if args.wal != "mem":
+        wal_base = tempfile.mkdtemp(prefix="ctpu-mp-wal-")
 
     # Replica processes must never touch the TPU (the sidecar owns it) —
     # pin them to the CPU platform so even an accidental jax op is local.
@@ -116,6 +126,8 @@ def main() -> None:
                 "--seconds", str(args.seconds),
                 "--warmup", str(args.warmup),
                 "--presign", str(args.presign),
+                "--wal", args.wal,
+                "--wal-base", wal_base,
             ]
             proc = subprocess.Popen(
                 cmd,
@@ -170,6 +182,10 @@ def main() -> None:
             proc.wait()
         if sidecar_proc is not None:
             sidecar_proc.wait()
+        if wal_base:
+            import shutil
+
+            shutil.rmtree(wal_base, ignore_errors=True)
 
 
 if __name__ == "__main__":

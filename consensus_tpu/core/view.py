@@ -357,16 +357,34 @@ class View:
         # is durable (WAL-before-send, view.go:404-414) and the proposal is
         # verified.  All callbacks run on the replica's scheduler thread
         # (group-commit flushes are scheduler events), so the gates need no
-        # lock; _curr_prepare_sent doubles as the sent-once guard (it is
-        # reset by _start_next_seq).
-        gate = {"durable": False, "verified": False}
+        # lock; gate["prepare_sent"] is the sent-once guard (late flushes
+        # may fire after _start_next_seq reset _curr_prepare_sent).
+        gate = {"durable": False, "verified": False, "prepare_sent": False}
 
         def maybe_send_prepare() -> None:
-            if self.stopped or not (gate["durable"] and gate["verified"]):
+            if not (gate["durable"] and gate["verified"]) or gate["prepare_sent"]:
+                return
+            gate["prepare_sent"] = True
+            if self.stopped:
+                # Aborted view: do NOT utter stale-view votes.  A late
+                # flush firing after a view change would broadcast a
+                # wrong-view message — and if this replica is the NEW
+                # view's leader, peers treat wrong-view-from-leader as
+                # leader sickness (handle_message) and abort the view they
+                # just installed.
                 return
             if self.proposal_sequence != prepare.seq:
-                return  # stale callback from a bygone sequence
-            if self._curr_prepare_sent is not None:
+                # LATE but durable AND verified (a group-commit flush that
+                # landed after this view advanced a sequence): still reveal
+                # it — skipping the send can wedge peers that are still
+                # collecting this quorum (found by the multi-process
+                # disk-group bench: a replica that decided via its peers'
+                # votes before its own flush fired never uttered its vote,
+                # and a laggard starved forever).  Safety is unchanged —
+                # the endorsement is durably pinned and carries its own
+                # (view, seq).  Only the assist state, which belongs to the
+                # CURRENT sequence, must not be touched.
+                self._comm.broadcast(prepare)
                 return
             # The assist copy is only armed here — retransmission help must
             # never reveal an un-persisted message either.
@@ -378,11 +396,16 @@ class View:
         def send_after_durable() -> None:
             # Under group commit this fires from the batched fsync event;
             # default mode fires inline during save().  Idempotent: a retried
-            # flush must not re-reveal the pre-prepare, and a callback that a
-            # failed fsync delayed past its own sequence must not fire at all.
-            if self.stopped or gate["durable"]:
+            # flush must not re-reveal the pre-prepare (durability is a fact
+            # once achieved — the flush layer fires each callback exactly
+            # once, and the gate guards the rest).
+            if gate["durable"]:
                 return
-            if self.proposal_sequence != prepare.seq:
+            gate["durable"] = True
+            if self.stopped:
+                # Aborted view: reveal nothing (a stale-view pre-prepare
+                # from a replica that leads the NEW view too would read as
+                # leader sickness to its peers — see maybe_send_prepare).
                 return
             if i_am_leader:
                 # Reveal the proposal the moment it is durable — BEFORE our
@@ -399,7 +422,6 @@ class View:
                 # pins us to this proposal at this (view, seq) across
                 # crashes, so no equivocation window opens.
                 self._comm.broadcast(pp)
-            gate["durable"] = True
             maybe_send_prepare()
 
         if i_am_leader:
@@ -471,15 +493,21 @@ class View:
         )
 
         def send_after_durable() -> None:
-            if self.stopped or self.proposal_sequence != commit.seq:
-                return
-            self._curr_commit_sent = Commit(
-                view=commit.view,
-                seq=commit.seq,
-                digest=commit.digest,
-                signature=commit.signature,
-                assist=True,
-            )
+            if self.stopped:
+                return  # aborted view: never utter stale-view votes
+            if self.proposal_sequence == commit.seq:
+                self._curr_commit_sent = Commit(
+                    view=commit.view,
+                    seq=commit.seq,
+                    digest=commit.digest,
+                    signature=commit.signature,
+                    assist=True,
+                )
+            # Broadcast even when the flush landed late (same view, next
+            # sequence): the commit is durable and peers still assembling
+            # this quorum need it — a skipped send can starve a laggard
+            # forever (the group-commit wedge; see maybe_send_prepare
+            # above).  Only the assist state is current-sequence-scoped.
             self._comm.broadcast(commit)
 
         self.phase = Phase.PREPARED

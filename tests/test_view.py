@@ -627,3 +627,74 @@ def test_leader_own_bad_proposal_reveals_but_never_prepares():
     assert [type(m).__name__ for m in h.comm.broadcasts] == ["PrePrepare"]
     assert h.fd.complaints == [(0, False)]
     assert h.view.phase == Phase.ABORT
+
+
+def test_late_durability_still_broadcasts_prepare_and_commit():
+    """Group-commit wedge regression (found by the multi-process
+    disk-group bench): a replica that DECIDES via its peers' votes before
+    its own WAL flush lands used to skip broadcasting its prepare/commit
+    entirely (stale-sequence guard) — starving any peer still collecting
+    that quorum, forever (sync cannot always rescue: the stub/healthy-path
+    synchronizer has nothing newer).  A late flush must still broadcast
+    the durable votes; only the current-sequence assist state is off-limits."""
+    h = Harness(self_id=2, leader_id=1)
+    pending = []
+    h.state.save = lambda record, on_durable=None: (
+        h.state.saved.append(record),
+        pending.append(on_durable),
+    )
+
+    proposal = h.make_proposal()
+    digest = proposal.digest()
+    h.view.handle_message(1, h.pre_prepare(proposal))
+    assert h.view.phase == Phase.PROPOSED
+    h.view.handle_message(3, Prepare(view=0, seq=0, digest=digest))
+    h.view.handle_message(4, Prepare(view=0, seq=0, digest=digest))
+    assert h.view.phase == Phase.PREPARED
+    # Nothing broadcast yet: both records' durability is still pending.
+    assert h.comm.broadcasts == []
+
+    # Quorum-1 commits from peers: the replica decides and moves to seq 1
+    # with its own prepare/commit still unflushed.
+    h.view.handle_message(1, Commit(view=0, seq=0, digest=digest, signature=sig_for(1)))
+    h.view.handle_message(3, Commit(view=0, seq=0, digest=digest, signature=sig_for(3)))
+    assert h.decider.decisions, "quorum of peer commits must decide"
+    assert h.view.proposal_sequence == 1
+
+    # The group flush finally lands: BOTH votes must go out late.
+    for cb in pending:
+        if cb is not None:
+            cb()
+    kinds = [type(m).__name__ for m in h.comm.broadcasts]
+    assert "Prepare" in kinds, "late-durable prepare was swallowed"
+    assert "Commit" in kinds, "late-durable commit was swallowed"
+    # The assist state belongs to sequence 1 and must NOT have been armed
+    # by the stale callbacks.
+    assert h.view._curr_prepare_sent is None
+    assert h.view._curr_commit_sent is None
+
+
+def test_late_durability_on_aborted_view_stays_silent():
+    """Counterpart to the late-broadcast fix: once the view is ABORTED (a
+    view change ran), a late flush must utter NOTHING — a stale-view vote
+    from a replica that also leads the new view would read as leader
+    sickness to its peers (wrong-view-from-leader => complain + abort) and
+    tear down the view they just installed."""
+    h = Harness(self_id=2, leader_id=1)
+    pending = []
+    h.state.save = lambda record, on_durable=None: (
+        h.state.saved.append(record),
+        pending.append(on_durable),
+    )
+    proposal = h.make_proposal()
+    digest = proposal.digest()
+    h.view.handle_message(1, h.pre_prepare(proposal))
+    h.view.handle_message(3, Prepare(view=0, seq=0, digest=digest))
+    h.view.handle_message(4, Prepare(view=0, seq=0, digest=digest))
+    assert h.view.phase == Phase.PREPARED and h.comm.broadcasts == []
+
+    h.view.abort()  # view change won
+    for cb in pending:
+        if cb is not None:
+            cb()
+    assert h.comm.broadcasts == [], "aborted view uttered a stale-view vote"
