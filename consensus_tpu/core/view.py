@@ -382,8 +382,19 @@ class View:
                 # votes before its own flush fired never uttered its vote,
                 # and a laggard starved forever).  Safety is unchanged —
                 # the endorsement is durably pinned and carries its own
-                # (view, seq).  Only the assist state, which belongs to the
-                # CURRENT sequence, must not be touched.
+                # (view, seq).  The CURRENT-sequence assist slot is
+                # off-limits, but a flush exactly one sequence late may arm
+                # the PREV-seq assist copy (empty precisely because the
+                # send was deferred), so the retransmission machinery
+                # covers loss of this one late broadcast.
+                if (
+                    self.proposal_sequence == prepare.seq + 1
+                    and self._prev_prepare_sent is None
+                ):
+                    self._prev_prepare_sent = Prepare(
+                        view=prepare.view, seq=prepare.seq,
+                        digest=prepare.digest, assist=True,
+                    )
                 self._comm.broadcast(prepare)
                 return
             # The assist copy is only armed here — retransmission help must
@@ -495,19 +506,28 @@ class View:
         def send_after_durable() -> None:
             if self.stopped:
                 return  # aborted view: never utter stale-view votes
+            assist_copy = Commit(
+                view=commit.view,
+                seq=commit.seq,
+                digest=commit.digest,
+                signature=commit.signature,
+                assist=True,
+            )
             if self.proposal_sequence == commit.seq:
-                self._curr_commit_sent = Commit(
-                    view=commit.view,
-                    seq=commit.seq,
-                    digest=commit.digest,
-                    signature=commit.signature,
-                    assist=True,
-                )
+                self._curr_commit_sent = assist_copy
+            elif (
+                self.proposal_sequence == commit.seq + 1
+                and self._prev_commit_sent is None
+            ):
+                # One sequence late: arm the prev-seq assist slot (empty
+                # precisely because this send was deferred) so loss of the
+                # single late broadcast is retransmittable.
+                self._prev_commit_sent = assist_copy
             # Broadcast even when the flush landed late (same view, next
             # sequence): the commit is durable and peers still assembling
             # this quorum need it — a skipped send can starve a laggard
             # forever (the group-commit wedge; see maybe_send_prepare
-            # above).  Only the assist state is current-sequence-scoped.
+            # above).
             self._comm.broadcast(commit)
 
         self.phase = Phase.PREPARED

@@ -6,6 +6,7 @@ Parity: reference test/ (network.go, test_app.go).
 from consensus_tpu.testing.app import (
     ByteInspector,
     Cluster,
+    DeferredMemWAL,
     MemWAL,
     Node,
     TestApp,
@@ -24,6 +25,7 @@ __all__ = [
     "Node",
     "TestApp",
     "ByteInspector",
+    "DeferredMemWAL",
     "MemWAL",
     "make_request",
     "pack_batch",

@@ -91,6 +91,59 @@ class MemWAL(WriteAheadLog):
         return list(self._backing)
 
 
+class DeferredMemWAL(WriteAheadLog):
+    """MemWAL with GROUP-COMMIT durability semantics on the sim clock:
+    appends land in a pending buffer, and only a flush (after ``window``
+    sim-seconds) moves them into the crash-surviving backing list and
+    fires their durability callbacks.  A simulated crash with unflushed
+    records LOSES them — exactly the torn-tail realism a real group-commit
+    window adds (and the regime that exposed the late-flush liveness
+    wedge; see view.py::maybe_send_prepare)."""
+
+    def __init__(self, backing: list[bytes], scheduler, window: float) -> None:
+        self._backing = backing
+        self._sched = scheduler
+        self._window = window
+        self._pending: list[tuple[bytes, bool, object]] = []
+        self._timer = None
+        self._dead = False
+
+    def append(self, entry: bytes, truncate_to: bool = False, on_durable=None) -> None:
+        if self._dead:
+            return
+        self._pending.append((entry, truncate_to, on_durable))
+        if self._timer is None:
+            self._timer = self._sched.call_later(
+                self._window, self._flush, name="sim-wal-group-flush"
+            )
+
+    def _flush(self) -> None:
+        self._timer = None
+        if self._dead:
+            return
+        pending, self._pending = self._pending, []
+        for entry, truncate_to, _ in pending:
+            if truncate_to:
+                self._backing.clear()
+            self._backing.append(entry)
+        for _, _, on_durable in pending:
+            if on_durable is not None:
+                on_durable()
+
+    def abandon(self) -> None:
+        """Simulated process death: unflushed records are gone and the
+        flush timer must never fire into a dead replica."""
+        self._dead = True
+        self._pending.clear()
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    @property
+    def entries(self) -> list[bytes]:
+        return list(self._backing)
+
+
 class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
     """Implements every application-side port with trivial crypto.
 
@@ -177,6 +230,7 @@ class Node:
         self.config = config
         self.app = TestApp(node_id, cluster)
         self.wal_backing: list[bytes] = []
+        self.wal: Optional[WriteAheadLog] = None
         self.consensus: Optional[Consensus] = None
         self.running = False
         #: Optional Metrics bundle handed to the next (re)build.
@@ -185,13 +239,19 @@ class Node:
     def start(self) -> None:
         comm = self.cluster.network.register(self.node_id, self._on_message)
         last = self.app.ledger[-1] if self.app.ledger else None
+        window = self.cluster.durability_window
+        self.wal = (
+            DeferredMemWAL(self.wal_backing, self.cluster.scheduler, window)
+            if window > 0
+            else MemWAL(self.wal_backing)
+        )
         self.consensus = Consensus(
             config=self.config,
             scheduler=self.cluster.scheduler,
             comm=comm,
             application=self.app,
             assembler=self.app,
-            wal=MemWAL(self.wal_backing),
+            wal=self.wal,
             signer=self.app,
             verifier=self.app,
             request_inspector=self.app.inspector,
@@ -208,6 +268,8 @@ class Node:
         """Hard-stop: drop off the network and kill all components."""
         self.running = False
         self.cluster.network.unregister(self.node_id)
+        if isinstance(self.wal, DeferredMemWAL):
+            self.wal.abandon()  # unflushed group-commit records die with us
         if self.consensus is not None:
             self.consensus.stop()
             self.consensus = None
@@ -241,7 +303,12 @@ class Cluster:
         seed: int = 0,
         config_tweaks: Optional[dict] = None,
         leader_rotation: bool = False,
+        durability_window: float = 0.0,
     ) -> None:
+        #: > 0 gives every node group-commit durability semantics
+        #: (DeferredMemWAL): appends become durable — and their deferred
+        #: sends fire — only after this many sim-seconds.
+        self.durability_window = durability_window
         self.scheduler = SimScheduler()
         self.network = SimNetwork(self.scheduler, seed=seed)
         self.network.membership = list(range(1, n + 1))

@@ -668,10 +668,17 @@ def test_late_durability_still_broadcasts_prepare_and_commit():
     kinds = [type(m).__name__ for m in h.comm.broadcasts]
     assert "Prepare" in kinds, "late-durable prepare was swallowed"
     assert "Commit" in kinds, "late-durable commit was swallowed"
-    # The assist state belongs to sequence 1 and must NOT have been armed
-    # by the stale callbacks.
+    # The CURRENT-sequence assist slots belong to sequence 1 and must NOT
+    # have been armed by the stale callbacks...
     assert h.view._curr_prepare_sent is None
     assert h.view._curr_commit_sent is None
+    # ...but the PREV-seq assist copies (empty precisely because the sends
+    # were deferred) are armed, so loss of the single late broadcast is
+    # covered by the retransmission machinery.
+    assert h.view._prev_prepare_sent is not None
+    assert h.view._prev_prepare_sent.assist and h.view._prev_prepare_sent.seq == 0
+    assert h.view._prev_commit_sent is not None
+    assert h.view._prev_commit_sent.assist and h.view._prev_commit_sent.seq == 0
 
 
 def test_late_durability_on_aborted_view_stays_silent():
