@@ -214,7 +214,15 @@ class PersistedState:
         append is best-effort and tail-guarded: if ANY record followed (a
         commit, a view-change), the upgrade is skipped — a commit makes it
         moot (PREPARED restore doesn't re-verify) and anything else must
-        stay the tail the restore logic sees."""
+        stay the tail the restore logic sees.
+
+        The append deliberately does NOT truncate: restore only decodes the
+        last record(s), so the older verified=False copy on disk is
+        harmless, and truncate_to=True would force an eager fsync outside
+        any group-commit window — a second synchronous fsync on the
+        leader's critical path per decision (ADVICE r4).  Losing an
+        unflushed upgrade in a crash just re-verifies: the documented
+        best-effort behavior."""
         rec = self._mem_proposed
         if (
             rec is not None
@@ -226,7 +234,7 @@ class PersistedState:
             self._mem_proposed = upgraded
             if self._last_written is rec:
                 try:
-                    self._wal.append(encode_saved(upgraded), truncate_to=True)
+                    self._wal.append(encode_saved(upgraded), truncate_to=False)
                     self._last_written = upgraded
                 except Exception:
                     logger.exception(

@@ -25,10 +25,20 @@ Response payload: u8 status (0=ok, 1=error) | count result bytes / utf-8 error
 
 Addresses: a ``(host, port)`` tuple serves TCP (cross-container), a string
 serves a unix domain socket (same-host, lower latency — the common shape).
+TCP mode REQUIRES ``auth_secret`` (a shared secret): the handshake is
+MUTUAL (both ends prove knowledge of the secret over a domain-separated
+nonce pair) and derives a per-connection session key that MACs every frame
+in both directions — a verification verdict is consensus input, so a peer
+in path must not be able to forge "all valid" responses (it can still drop
+the connection; that is the failover path, not a safety hole).  Unix
+sockets rely on filesystem permissions instead but honour the secret when
+given.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import logging
 import os
 import socket
@@ -43,31 +53,89 @@ logger = logging.getLogger("consensus_tpu.net.sidecar")
 
 _FRAME = struct.Struct(">IQ")
 _ITEM = struct.Struct(">III")
-_MAX_FRAME = 256 * 1024 * 1024
+#: Default frame-size ceiling.  64 MiB comfortably fits the largest real
+#: sweep (a 16k-signature wave is < 2 MiB) while bounding what one
+#: misbehaving peer can make the server buffer (ADVICE r4).
+_MAX_FRAME = 64 * 1024 * 1024
+_NONCE_LEN = 32
+_MAC_LEN = 16
+_HANDSHAKE_TIMEOUT = 5.0
+#: Domain separation for the three HMAC uses (client proof, server proof,
+#: session-key derivation) so a transcript from one role can never stand in
+#: for another.
+_CLIENT_PROOF = b"ctpu-sidecar-client-v1"
+_SERVER_PROOF = b"ctpu-sidecar-server-v1"
+_SESSION_KEY = b"ctpu-sidecar-session-v1"
 
 Address = Union[tuple, str]
+
+
+def _hmac256(key: bytes, *parts: bytes) -> bytes:
+    mac = hmac.new(key, digestmod=hashlib.sha256)
+    for p in parts:
+        mac.update(p)
+    return mac.digest()
+
+
+def _frame_mac(key: bytes, direction: bytes, req_id: int, payload: bytes) -> bytes:
+    return _hmac256(key, direction, req_id.to_bytes(8, "big"), payload)[:_MAC_LEN]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        try:
+            chunk = sock.recv(n - len(buf))
+        except TimeoutError:
+            if buf:
+                # A stall MID-frame loses protocol sync; only an idle
+                # timeout at a frame boundary is benign (re-raised for the
+                # caller to swallow).
+                raise ConnectionError("sidecar stalled mid-frame")
+            raise
         if not chunk:
             raise ConnectionError("sidecar connection closed")
         buf.extend(chunk)
     return bytes(buf)
 
 
-def _read_frame(sock: socket.socket) -> tuple[int, bytes]:
+def _read_frame(
+    sock: socket.socket,
+    max_frame: int = _MAX_FRAME,
+    mac_key: Optional[bytes] = None,
+    direction: bytes = b"",
+) -> tuple[int, bytes]:
+    """Read one frame; with a session ``mac_key``, verify the trailing MAC
+    (keyed on direction + req_id + payload) and drop the connection on any
+    mismatch — an in-path forger must not be able to mint verdicts."""
     header = _recv_exact(sock, _FRAME.size)
     length, req_id = _FRAME.unpack(header)
-    if length > _MAX_FRAME:
+    if length > max_frame:
         raise ConnectionError(f"sidecar frame too large: {length}")
-    return req_id, _recv_exact(sock, length)
+    try:
+        payload = _recv_exact(sock, length)
+        if mac_key is not None:
+            mac = _recv_exact(sock, _MAC_LEN)
+            if not hmac.compare_digest(
+                mac, _frame_mac(mac_key, direction, req_id, payload)
+            ):
+                raise ConnectionError("sidecar frame MAC mismatch")
+    except TimeoutError:
+        raise ConnectionError("sidecar stalled mid-frame") from None
+    return req_id, payload
 
 
-def _write_frame(sock: socket.socket, req_id: int, payload: bytes) -> None:
-    sock.sendall(_FRAME.pack(len(payload), req_id) + payload)
+def _write_frame(
+    sock: socket.socket,
+    req_id: int,
+    payload: bytes,
+    mac_key: Optional[bytes] = None,
+    direction: bytes = b"",
+) -> None:
+    buf = _FRAME.pack(len(payload), req_id) + payload
+    if mac_key is not None:
+        buf += _frame_mac(mac_key, direction, req_id, payload)
+    sock.sendall(buf)
 
 
 def encode_request(messages, signatures, keys) -> bytes:
@@ -101,11 +169,42 @@ class VerifySidecarServer:
     one device launch).  One thread per connection reads requests; each
     request is served on its own worker thread — a replica pipelining
     decisions can have several requests in flight on one connection, and a
-    blocking coalescer call must not serialize them."""
+    blocking coalescer call must not serialize them.
 
-    def __init__(self, address: Address, engine) -> None:
+    ``auth_secret`` (REQUIRED for TCP): shared secret for the per-connection
+    challenge-response — the server sends a random nonce, the peer must
+    answer ``HMAC-SHA256(secret, nonce)`` within ``_HANDSHAKE_TIMEOUT`` or
+    the connection is dropped before any frame is read.  Unix sockets may
+    omit it (filesystem permissions are the perimeter) but honour it when
+    given.
+
+    ``max_inflight`` bounds the worker threads PER CONNECTION: when a peer
+    has that many requests outstanding the connection's read loop blocks,
+    pushing backpressure into the peer's socket instead of spawning
+    unbounded threads (ADVICE r4 flood surface).
+
+    ``io_timeout`` is the per-connection socket timeout: a peer that stops
+    READING its responses stalls a worker's send for at most this long,
+    after which the connection is torn down and its worker slots recovered —
+    otherwise a connect-flood-abandon peer would park ``max_inflight``
+    threads per connection forever."""
+
+    def __init__(
+        self,
+        address: Address,
+        engine,
+        *,
+        auth_secret: Optional[bytes] = None,
+        max_inflight: int = 32,
+        max_frame: int = _MAX_FRAME,
+        io_timeout: float = 60.0,
+    ) -> None:
         self._address = address
         self._engine = engine
+        self._secret = auth_secret
+        self._max_inflight = max_inflight
+        self._max_frame = max_frame
+        self._io_timeout = io_timeout
         self._listener: Optional[socket.socket] = None
         self._stopping = False
 
@@ -122,6 +221,13 @@ class VerifySidecarServer:
                 pass
             listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             listener.bind(self._address)
+        elif self._secret is None:
+            raise ValueError(
+                "TCP sidecar mode requires auth_secret: an unauthenticated "
+                "TCP listener hands free verification cycles to anyone who "
+                "can reach the port (use a unix socket for same-host "
+                "deployments)"
+            )
         else:
             listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -162,14 +268,58 @@ class VerifySidecarServer:
                 name="sidecar-conn",
             ).start()
 
+    def _handshake(self, conn: socket.socket) -> Optional[bytes]:
+        """MUTUAL challenge-response: the peer proves knowledge of the
+        secret over (server_nonce, client_nonce), the server proves it back,
+        and both derive the per-connection session key that MACs every
+        frame.  Returns the session key, or None to drop the peer.  Runs
+        under a deadline so an idle connect cannot park a thread."""
+        conn.settimeout(_HANDSHAKE_TIMEOUT)
+        try:
+            server_nonce = os.urandom(_NONCE_LEN)
+            conn.sendall(server_nonce)
+            client_nonce = _recv_exact(conn, _NONCE_LEN)
+            answer = _recv_exact(conn, hashlib.sha256().digest_size)
+            expect = _hmac256(
+                self._secret, _CLIENT_PROOF, server_nonce, client_nonce
+            )
+            if not hmac.compare_digest(answer, expect):
+                logger.warning("sidecar: rejected peer with bad auth answer")
+                return None
+            conn.sendall(
+                _hmac256(self._secret, _SERVER_PROOF, server_nonce, client_nonce)
+            )
+            return _hmac256(self._secret, _SESSION_KEY, server_nonce, client_nonce)
+        except (ConnectionError, OSError):
+            logger.warning("sidecar: peer failed to complete auth handshake")
+            return None
+
     def _serve_conn(self, conn: socket.socket) -> None:
         write_lock = threading.Lock()
+        # Per-connection in-flight bound: acquire before dispatch, release
+        # when the worker answers; a saturated peer blocks HERE (TCP
+        # backpressure) instead of growing the thread count.
+        slots = threading.BoundedSemaphore(self._max_inflight)
+        mac_key: Optional[bytes] = None
         try:
+            if self._secret is not None:
+                mac_key = self._handshake(conn)
+                if mac_key is None:
+                    return
+            # Socket timeout bounds worker SENDS to a non-reading peer; the
+            # read loop below treats frame-boundary timeouts as idle.
+            conn.settimeout(self._io_timeout)
             while True:
-                req_id, payload = _read_frame(conn)
+                try:
+                    req_id, payload = _read_frame(
+                        conn, self._max_frame, mac_key, b"c2s"
+                    )
+                except TimeoutError:
+                    continue  # idle peer at a frame boundary
+                slots.acquire()
                 threading.Thread(
                     target=self._serve_request,
-                    args=(conn, write_lock, req_id, payload),
+                    args=(conn, write_lock, slots, mac_key, req_id, payload),
                     daemon=True,
                     name="sidecar-verify",
                 ).start()
@@ -181,7 +331,9 @@ class VerifySidecarServer:
             except OSError:
                 pass
 
-    def _serve_request(self, conn, write_lock, req_id: int, payload: bytes) -> None:
+    def _serve_request(
+        self, conn, write_lock, slots, mac_key, req_id: int, payload: bytes
+    ) -> None:
         try:
             messages, signatures, keys = decode_request(payload)
             results = np.asarray(self._engine.verify_batch(messages, signatures, keys))
@@ -193,9 +345,25 @@ class VerifySidecarServer:
             body = b"\x01" + repr(exc).encode()
         try:
             with write_lock:
-                _write_frame(conn, req_id, body)
+                try:
+                    _write_frame(conn, req_id, body, mac_key, b"s2c")
+                except OSError:
+                    # Client gone OR not reading (send timed out): close
+                    # WHILE STILL HOLDING write_lock — a partial frame may
+                    # be on the wire, and the next writer interleaving into
+                    # it would splice its header bytes into this frame's
+                    # declared payload (a forged verdict on un-MAC'd unix
+                    # connections).  A dead fd makes every queued writer
+                    # fail fast and recovers the read loop's slots.
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    raise
         except OSError:
-            pass  # client went away; its loss
+            pass
+        finally:
+            slots.release()
 
 
 class SidecarVerifierClient:
@@ -213,6 +381,9 @@ class SidecarVerifierClient:
     ``local_engine.verify_host``) without a socket round trip — quorum-sized
     checks and single signatures gain nothing from the device and shouldn't
     pay the sidecar RTT + coalescing window.
+
+    ``auth_secret``: shared secret answering the server's TCP
+    challenge-response handshake (must match the server's).
     """
 
     def __init__(
@@ -224,6 +395,7 @@ class SidecarVerifierClient:
         connect_timeout: float = 5.0,
         bypass_below: int = 0,
         probe_interval: float = 10.0,
+        auth_secret: Optional[bytes] = None,
     ) -> None:
         self._address = address
         self._timeout = request_timeout
@@ -231,8 +403,15 @@ class SidecarVerifierClient:
         self._local = local_engine
         self._bypass_below = bypass_below if local_engine is not None else 0
         self._probe_interval = probe_interval
-        self._lock = threading.Lock()  # guards socket create + sends
+        self._secret = auth_secret
+        self._mac_key: Optional[bytes] = None  # per-connection session key
+        self._lock = threading.Lock()  # guards socket create + pending map
         self._sock: Optional[socket.socket] = None
+        #: Serializes SENDS on the current socket, separately from
+        #: ``_lock``: a send that stalls (wedged sidecar, full kernel
+        #: buffer) must not block verify calls that only need the pending
+        #: map (ADVICE r4 medium).  Replaced together with the socket.
+        self._wlock = threading.Lock()
         self._pending: dict[int, dict] = {}
         self._next_id = 0
         self._reader: Optional[threading.Thread] = None
@@ -343,12 +522,44 @@ class SidecarVerifierClient:
             self._address if isinstance(self._address, str)
             else tuple(self._address)
         )
-        sock.settimeout(None)
+        self._mac_key = None
+        if self._secret is not None:
+            try:
+                server_nonce = _recv_exact(sock, _NONCE_LEN)
+                client_nonce = os.urandom(_NONCE_LEN)
+                sock.sendall(
+                    client_nonce
+                    + _hmac256(
+                        self._secret, _CLIENT_PROOF, server_nonce, client_nonce
+                    )
+                )
+                proof = _recv_exact(sock, hashlib.sha256().digest_size)
+                expect = _hmac256(
+                    self._secret, _SERVER_PROOF, server_nonce, client_nonce
+                )
+                if not hmac.compare_digest(proof, expect):
+                    raise ConnectionError(
+                        "sidecar failed mutual auth (bad server proof)"
+                    )
+            except BaseException:
+                # Close on EVERY failed-handshake path (rejection, EOF,
+                # timeout) — each verify retry would otherwise abandon an
+                # open fd to the GC.
+                sock.close()
+                raise
+            self._mac_key = _hmac256(
+                self._secret, _SESSION_KEY, server_nonce, client_nonce
+            )
+        # A real timeout (not None) so a blocked sendall on a wedged sidecar
+        # surfaces as TimeoutError instead of hanging the sender forever;
+        # the reader treats frame-boundary timeouts as idle (ADVICE r4).
+        sock.settimeout(self._timeout)
         if sock.family == socket.AF_INET:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
+        self._wlock = threading.Lock()
         self._reader = threading.Thread(
-            target=self._read_loop, args=(sock,), daemon=True,
+            target=self._read_loop, args=(sock, self._mac_key), daemon=True,
             name="sidecar-client-reader",
         )
         self._reader.start()
@@ -359,27 +570,69 @@ class SidecarVerifierClient:
     ) -> np.ndarray:
         payload = encode_request(messages, signatures, keys)
         waiter = {"event": threading.Event(), "body": None}
-        send_error: Optional[OSError] = None
         with self._lock:
             sock = self._ensure_connected()
+            wlock = self._wlock
+            mac_key = self._mac_key
             req_id = self._next_id
             self._next_id += 1
+            waiter["sock"] = sock
             self._pending[req_id] = waiter
-            try:
-                _write_frame(sock, req_id, payload)
-            except OSError as exc:
+        # OUTSIDE self._lock: a send that stalls on a full kernel buffer
+        # (wedged sidecar) must not block other verify calls — they only
+        # need the pending map.  The per-socket wlock keeps frames whole;
+        # the socket's timeout turns a dead stall into TimeoutError, which
+        # verify_batch maps to suspect + local failover.  ONE absolute
+        # deadline covers every stage (wlock queueing, the send itself, the
+        # response wait) so a call behind a stalled sender still fails over
+        # within its own budget rather than 3x it.
+        budget = timeout if timeout is not None else self._timeout
+        deadline = time.monotonic() + budget
+
+        def _give_up_queued(reason: str):
+            # Budget spent without touching the wire: the socket is healthy,
+            # so concurrent waiters keep it — only this call bows out.
+            with self._lock:
                 self._pending.pop(req_id, None)
-                send_error = exc
-        if send_error is not None:
-            # Outside the lock: _drop_socket re-acquires it (calling it
-            # while held would self-deadlock and wedge every verify).
-            self._drop_socket(sock)
-            raise send_error
-        if not waiter["event"].wait(timeout if timeout is not None else self._timeout):
-            self._pending.pop(req_id, None)
-            raise TimeoutError(
-                f"sidecar did not answer within {self._timeout}s"
-            )
+            return TimeoutError(reason)
+
+        if not wlock.acquire(timeout=budget):
+            raise _give_up_queued(f"sidecar send queue stalled for {budget}s")
+        try:
+            if waiter["event"].is_set():
+                raise ConnectionError("sidecar connection lost before send")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise _give_up_queued(
+                    f"sidecar send queue stalled for {budget}s"
+                )
+            # Shrink the send window to what's left of the budget (restored
+            # after; the reader tolerates frame-boundary timeouts anyway).
+            # A timeout DURING sendall leaves a partial frame on the wire,
+            # so that path must drop the socket.
+            sock.settimeout(min(remaining, self._timeout))
+            try:
+                _write_frame(sock, req_id, payload, mac_key, b"c2s")
+            except OSError as exc:
+                with self._lock:
+                    self._pending.pop(req_id, None)
+                self._drop_socket(sock)
+                raise exc
+            finally:
+                try:
+                    sock.settimeout(self._timeout)
+                except OSError:
+                    pass
+        except ConnectionError:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise
+        finally:
+            wlock.release()
+        if not waiter["event"].wait(max(0.0, deadline - time.monotonic())):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(f"sidecar did not answer within {budget}s")
         body = waiter["body"]
         if body is None:
             raise ConnectionError("sidecar connection lost mid-request")
@@ -390,11 +643,15 @@ class SidecarVerifierClient:
             raise ValueError("sidecar returned wrong result count")
         return results
 
-    def _read_loop(self, sock: socket.socket) -> None:
+    def _read_loop(self, sock: socket.socket, mac_key: Optional[bytes]) -> None:
         try:
             while True:
-                req_id, body = _read_frame(sock)
-                waiter = self._pending.pop(req_id, None)
+                try:
+                    req_id, body = _read_frame(sock, _MAX_FRAME, mac_key, b"s2c")
+                except TimeoutError:
+                    continue  # idle at a frame boundary (socket timeout)
+                with self._lock:
+                    waiter = self._pending.pop(req_id, None)
                 if waiter is not None:
                     waiter["body"] = body
                     waiter["event"].set()
@@ -404,16 +661,24 @@ class SidecarVerifierClient:
             self._drop_socket(sock)
 
     def _drop_socket(self, sock: socket.socket) -> None:
-        """Fail every in-flight request and let the next call reconnect."""
+        """Fail THIS socket's in-flight requests and let the next call
+        reconnect.  Waiters registered on a newer socket are left alone — a
+        stale reader thread's teardown racing a reconnect must not wipe
+        fresh requests (ADVICE r4)."""
         with self._lock:
             if self._sock is sock:
                 self._sock = None
-            pending, self._pending = dict(self._pending), {}
+            stale = {
+                rid: w for rid, w in self._pending.items()
+                if w.get("sock") is sock
+            }
+            for rid in stale:
+                del self._pending[rid]
         try:
             sock.close()
         except OSError:
             pass
-        for waiter in pending.values():
+        for waiter in stale.values():
             waiter["event"].set()  # body stays None -> ConnectionError
 
     def close(self) -> None:

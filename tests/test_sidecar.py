@@ -18,6 +18,8 @@ from consensus_tpu.net.sidecar import (
     encode_request,
 )
 
+SECRET = b"test-shared-secret"
+
 
 class FakeEngine:
     """Valid iff sig == b"good"; counts launches."""
@@ -58,10 +60,10 @@ def server_address(request, tmp_path):
 
 def test_round_trip_over_socket(server_address):
     engine = FakeEngine()
-    server = VerifySidecarServer(server_address, engine)
+    server = VerifySidecarServer(server_address, engine, auth_secret=SECRET)
     server.start()
     try:
-        client = SidecarVerifierClient(server.address)
+        client = SidecarVerifierClient(server.address, auth_secret=SECRET)
         out = client.verify_batch(
             [b"m1", b"m2", b"m3"], [b"good", b"bad", b"good"], [b"k"] * 3
         )
@@ -78,12 +80,12 @@ def test_concurrent_clients_all_get_correct_slices(server_address):
     """Many client processes (threads here; the socket boundary is the same)
     with interleaved requests — every caller gets exactly its own results."""
     engine = FakeEngine()
-    server = VerifySidecarServer(server_address, engine)
+    server = VerifySidecarServer(server_address, engine, auth_secret=SECRET)
     server.start()
     results = {}
     try:
         def worker(i):
-            client = SidecarVerifierClient(server.address)
+            client = SidecarVerifierClient(server.address, auth_secret=SECRET)
             pattern = [b"good" if (i + j) % 2 == 0 else b"bad" for j in range(20)]
             out = client.verify_batch([b"m"] * 20, pattern, [b"k"] * 20)
             results[i] = (pattern, list(out))
@@ -109,12 +111,12 @@ def test_sidecar_coalesces_processes_into_one_launch():
 
     engine = FakeEngine()
     coalescer = ThreadCoalescingVerifier(engine, window=0.05, max_batch=40)
-    server = VerifySidecarServer(("127.0.0.1", 0), coalescer)
+    server = VerifySidecarServer(("127.0.0.1", 0), coalescer, auth_secret=SECRET)
     server.start()
     results = {}
     try:
         def worker(i):
-            client = SidecarVerifierClient(server.address)
+            client = SidecarVerifierClient(server.address, auth_secret=SECRET)
             out = client.verify_batch([b"m"] * 10, [b"good"] * 10, [b"k"] * 10)
             results[i] = out.all()
             client.close()
@@ -137,10 +139,10 @@ def test_engine_error_is_served_as_error_not_disconnect():
         def verify_batch(self, m, s, k):
             raise RuntimeError("kernel exploded")
 
-    server = VerifySidecarServer(("127.0.0.1", 0), Boom())
+    server = VerifySidecarServer(("127.0.0.1", 0), Boom(), auth_secret=SECRET)
     server.start()
     try:
-        client = SidecarVerifierClient(server.address)
+        client = SidecarVerifierClient(server.address, auth_secret=SECRET)
         with pytest.raises(RuntimeError, match="kernel exploded"):
             client.verify_batch([b"m"], [b"s"], [b"k"])
         # The connection survives an engine error (next request still works
@@ -182,10 +184,11 @@ def test_server_death_mid_flight_fails_over():
             return np.ones(len(m), dtype=bool)
 
     local = FakeEngine()
-    server = VerifySidecarServer(("127.0.0.1", 0), Slow())
+    server = VerifySidecarServer(("127.0.0.1", 0), Slow(), auth_secret=SECRET)
     server.start()
     client = SidecarVerifierClient(
-        server.address, local_engine=local, request_timeout=30.0
+        server.address, local_engine=local, request_timeout=30.0,
+        auth_secret=SECRET,
     )
     out = {}
 
@@ -209,9 +212,9 @@ def test_send_failure_falls_back_without_deadlock(monkeypatch):
     import consensus_tpu.net.sidecar as sc
 
     local = FakeEngine()
-    server = VerifySidecarServer(("127.0.0.1", 0), FakeEngine())
+    server = VerifySidecarServer(("127.0.0.1", 0), FakeEngine(), auth_secret=SECRET)
     server.start()
-    client = SidecarVerifierClient(server.address, local_engine=local)
+    client = SidecarVerifierClient(server.address, local_engine=local, auth_secret=SECRET)
     try:
         assert list(client.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
 
@@ -263,11 +266,11 @@ def test_wedged_sidecar_marks_suspect_and_probes_back():
             return np.array([x == b"good" for x in s], dtype=bool)
 
     local = FakeEngine()
-    server = VerifySidecarServer(("127.0.0.1", 0), Gated())
+    server = VerifySidecarServer(("127.0.0.1", 0), Gated(), auth_secret=SECRET)
     server.start()
     client = SidecarVerifierClient(
         server.address, local_engine=local, request_timeout=0.3,
-        probe_interval=0.05,
+        probe_interval=0.05, auth_secret=SECRET,
     )
     try:
         # First call: stalls request_timeout, falls back, marks suspect.
@@ -292,4 +295,297 @@ def test_wedged_sidecar_marks_suspect_and_probes_back():
         assert list(out) == [True]
     finally:
         client.close()
+        server.stop()
+
+
+# -- hardening (ADVICE r4 / VERDICT r4 #6) ---------------------------------
+
+
+def test_tcp_server_without_secret_refuses_to_start():
+    """Unauthenticated TCP ingress is a free-verification + DoS surface:
+    the server refuses the configuration outright."""
+    server = VerifySidecarServer(("127.0.0.1", 0), FakeEngine())
+    with pytest.raises(ValueError, match="auth_secret"):
+        server.start()
+
+
+def test_wrong_secret_client_is_rejected():
+    """A peer that cannot HMAC the nonce is dropped before any frame is
+    read; with a local engine the replica still gets its answer."""
+    local = FakeEngine()
+    remote = FakeEngine()
+    server = VerifySidecarServer(("127.0.0.1", 0), remote, auth_secret=SECRET)
+    server.start()
+    try:
+        client = SidecarVerifierClient(
+            server.address, local_engine=local, auth_secret=b"not-the-secret",
+            request_timeout=2.0,
+        )
+        out = client.verify_batch([b"m"], [b"good"], [b"k"])
+        assert list(out) == [True]
+        assert local.calls == [1]       # served by the fallback
+        assert remote.calls == []       # never reached the engine
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_secretless_client_cannot_use_authed_server():
+    """A client that skips the handshake entirely never gets service (its
+    first frame header is consumed as a bad HMAC answer and the connection
+    is closed)."""
+    local = FakeEngine()
+    remote = FakeEngine()
+    server = VerifySidecarServer(("127.0.0.1", 0), remote, auth_secret=SECRET)
+    server.start()
+    try:
+        client = SidecarVerifierClient(
+            server.address, local_engine=local, request_timeout=2.0,
+        )
+        out = client.verify_batch([b"m"], [b"good"], [b"k"])
+        assert list(out) == [True]
+        assert remote.calls == []
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_flood_is_bounded_per_connection():
+    """max_inflight bounds concurrent worker threads for one connection:
+    a flood of pipelined requests backpressures into the socket instead of
+    spawning unbounded threads — and every request is still answered."""
+    import time
+
+    class Gauge:
+        """Tracks peak concurrent verify calls."""
+
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.live = 0
+            self.peak = 0
+
+        def verify_batch(self, m, s, k):
+            with self.lock:
+                self.live += 1
+                self.peak = max(self.peak, self.live)
+            time.sleep(0.02)  # hold the slot so concurrency is observable
+            with self.lock:
+                self.live -= 1
+            return np.ones(len(m), dtype=bool)
+
+    gauge = Gauge()
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), gauge, auth_secret=SECRET, max_inflight=4
+    )
+    server.start()
+    try:
+        client = SidecarVerifierClient(server.address, auth_secret=SECRET)
+        outs = {}
+
+        def worker(i):
+            outs[i] = client.verify_batch([b"m"], [b"good"], [b"k"]).all()
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20.0)
+        assert len(outs) == 24 and all(outs.values())
+        assert gauge.peak <= 4, f"flood exceeded max_inflight: {gauge.peak}"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_oversized_frame_drops_connection_not_server():
+    """A frame above max_frame closes that connection; the server keeps
+    serving well-behaved peers."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    engine = FakeEngine()
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), engine, auth_secret=SECRET, max_frame=1024
+    )
+    server.start()
+    try:
+        import os as os_mod
+
+        from consensus_tpu.net.sidecar import (
+            _CLIENT_PROOF,
+            _SERVER_PROOF,
+            _hmac256,
+            _recv_exact,
+        )
+
+        raw = socket_mod.create_connection(tuple(server.address), timeout=5.0)
+        raw.settimeout(5.0)
+        server_nonce = _recv_exact(raw, 32)
+        client_nonce = os_mod.urandom(32)
+        raw.sendall(
+            client_nonce
+            + _hmac256(SECRET, _CLIENT_PROOF, server_nonce, client_nonce)
+        )
+        proof = _recv_exact(raw, 32)
+        assert proof == _hmac256(SECRET, _SERVER_PROOF, server_nonce, client_nonce)
+        raw.sendall(struct_mod.pack(">IQ", 1 << 20, 7))  # oversized header
+        assert raw.recv(1) == b""  # server hung up (max_frame guard)
+        raw.close()
+
+        client = SidecarVerifierClient(server.address, auth_secret=SECRET)
+        assert list(client.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_drop_socket_spares_waiters_on_newer_socket():
+    """Regression (ADVICE r4): a stale reader thread's _drop_socket must
+    only fail waiters registered on ITS socket, not fresh requests on the
+    reconnected one."""
+    client = SidecarVerifierClient(("127.0.0.1", 1))
+    old_sock, new_sock = object(), object()
+    old_waiter = {"event": threading.Event(), "body": None, "sock": old_sock}
+    new_waiter = {"event": threading.Event(), "body": None, "sock": new_sock}
+    client._pending = {1: old_waiter, 2: new_waiter}
+    client._sock = new_sock
+
+    class _Closeable:
+        def close(self):
+            pass
+
+    old = _Closeable()
+    old_waiter["sock"] = old
+    client._drop_socket(old)
+    assert old_waiter["event"].is_set()          # stale waiter failed
+    assert not new_waiter["event"].is_set()      # fresh waiter untouched
+    assert client._pending == {2: new_waiter}
+    assert client._sock is new_sock              # current socket kept
+
+
+def test_blocked_send_times_out_and_fails_over():
+    """Regression (ADVICE r4 medium): a sidecar that accepts but never
+    READS must not wedge the sender forever — the socket send timeout
+    surfaces, the client marks the sidecar suspect, and the local engine
+    answers.  Other verify calls must not be blocked behind the stalled
+    send (the send happens outside the client lock)."""
+    import socket as socket_mod
+    import time
+
+    listener = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    addr = listener.getsockname()
+    local = FakeEngine()
+    # No auth (server never reads, so the handshake would stall): use a
+    # secretless client against a raw listener.
+    client = SidecarVerifierClient(
+        addr, local_engine=local, request_timeout=1.0, probe_interval=60.0,
+    )
+    try:
+        big = b"x" * (4 * 1024 * 1024)
+        out = {}
+
+        def stalled():
+            out["a"] = client.verify_batch([big] * 8, [b"good"] * 8, [b"k"] * 8)
+
+        t = threading.Thread(target=stalled)
+        start = time.monotonic()
+        t.start()
+        t.join(timeout=15.0)
+        assert not t.is_alive(), "blocked send never surfaced"
+        assert list(out["a"]) == [True] * 8  # answered by the fallback
+        # Suspect mode: the next call answers locally without re-stalling.
+        start = time.monotonic()
+        assert list(client.verify_batch([b"m"], [b"bad"], [b"k"])) == [False]
+        assert time.monotonic() - start < 0.5
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_in_path_forger_cannot_mint_verdicts():
+    """A relay that passes the handshake through (it cannot compute the
+    session key) and then forges an 'all valid' response must NOT be
+    believed: the frame MAC fails, the connection drops, and the replica
+    falls back to local verification — forged input never becomes a
+    consensus verdict."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    engine = FakeEngine()
+    server = VerifySidecarServer(("127.0.0.1", 0), engine, auth_secret=SECRET)
+    server.start()
+
+    relay = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    relay.bind(("127.0.0.1", 0))
+    relay.listen(1)
+
+    stop = threading.Event()
+
+    def mitm():
+        victim, _ = relay.accept()
+        upstream = socket_mod.create_connection(tuple(server.address), timeout=5.0)
+        victim.settimeout(5.0)
+        upstream.settimeout(5.0)
+        try:
+            # Relay the handshake verbatim: server nonce down, client
+            # nonce+proof up, server proof down.  The relay learns nothing
+            # usable — the session key needs the shared secret.
+            victim.sendall(upstream.recv(32))
+            up = b""
+            while len(up) < 64:
+                up += victim.recv(64 - len(up))
+            upstream.sendall(up)
+            victim.sendall(upstream.recv(32))
+            # Swallow the victim's first request, then FORGE "1 valid".
+            victim.recv(65536)
+            forged = b"\x00" + b"\x01"
+            victim.sendall(struct_mod.pack(">IQ", len(forged), 0) + forged
+                           + b"\x00" * 16)  # garbage MAC
+            stop.wait(5.0)
+        except OSError:
+            pass
+        finally:
+            victim.close()
+            upstream.close()
+
+    t = threading.Thread(target=mitm, daemon=True)
+    t.start()
+    local = FakeEngine()
+    client = SidecarVerifierClient(
+        relay.getsockname(), local_engine=local, auth_secret=SECRET,
+        request_timeout=3.0,
+    )
+    try:
+        out = client.verify_batch([b"m"], [b"bad"], [b"k"])
+        # The honest answer (invalid) from the LOCAL engine — never the
+        # forged "valid" verdict.
+        assert list(out) == [False]
+        assert local.calls == [1]
+    finally:
+        stop.set()
+        client.close()
+        relay.close()
+        server.stop()
+
+
+def test_idle_connection_survives_io_timeout():
+    """The server's per-connection io_timeout bounds SENDS to a non-reading
+    peer; an idle (but healthy) connection must NOT be dropped by it — the
+    read loop treats frame-boundary timeouts as idle and keeps waiting."""
+    import time
+
+    engine = FakeEngine()
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), engine, auth_secret=SECRET, io_timeout=0.2
+    )
+    server.start()
+    try:
+        client = SidecarVerifierClient(server.address, auth_secret=SECRET)
+        assert list(client.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
+        time.sleep(1.0)  # several io_timeout periods of silence
+        assert list(client.verify_batch([b"m"], [b"bad"], [b"k"])) == [False]
+        client.close()
+    finally:
         server.stop()
