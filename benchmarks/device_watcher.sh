@@ -20,7 +20,10 @@ PROBE_TIMEOUT=${CTPU_PROBE_TIMEOUT:-90}
 say() { echo "$(date -u +%H:%M:%SZ) $*" >> "$LOG"; }
 
 probe() {
-  timeout "$PROBE_TIMEOUT" python -c \
+  # -k: a probe stuck in an uninterruptible device call ignores SIGTERM;
+  # without the follow-up SIGKILL the watcher would block on the very
+  # wedge it exists to survive.
+  timeout -k 10 "$PROBE_TIMEOUT" python -c \
     "import jax.numpy as jnp; assert float(jnp.sum(jnp.ones((8,8))))==64.0" \
     >/dev/null 2>&1
 }

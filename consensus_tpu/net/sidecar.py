@@ -70,6 +70,12 @@ _SESSION_KEY = b"ctpu-sidecar-session-v1"
 Address = Union[tuple, str]
 
 
+class QueueStallTimeout(TimeoutError):
+    """The per-request budget expired while the request was still QUEUED
+    behind other senders — the wire itself was never observed to stall, so
+    callers must not treat this as evidence the sidecar is wedged."""
+
+
 def _hmac256(key: bytes, *parts: bytes) -> bytes:
     mac = hmac.new(key, digestmod=hashlib.sha256)
     for p in parts:
@@ -81,12 +87,19 @@ def _frame_mac(key: bytes, direction: bytes, req_id: int, payload: bytes) -> byt
     return _hmac256(key, direction, req_id.to_bytes(8, "big"), payload)[:_MAC_LEN]
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, patient: bool = False) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         try:
             chunk = sock.recv(n - len(buf))
         except TimeoutError:
+            if patient:
+                # The CLIENT reader trusts its one sidecar and must not
+                # tear a healthy connection down over a slow frame (another
+                # thread may also shorten the shared socket's deadline
+                # transiently); liveness comes from the per-request budget,
+                # whose expiry closes the socket and ends this recv.
+                continue
             if buf:
                 # A stall MID-frame loses protocol sync; only an idle
                 # timeout at a frame boundary is benign (re-raised for the
@@ -104,18 +117,19 @@ def _read_frame(
     max_frame: int = _MAX_FRAME,
     mac_key: Optional[bytes] = None,
     direction: bytes = b"",
+    patient: bool = False,
 ) -> tuple[int, bytes]:
     """Read one frame; with a session ``mac_key``, verify the trailing MAC
     (keyed on direction + req_id + payload) and drop the connection on any
     mismatch — an in-path forger must not be able to mint verdicts."""
-    header = _recv_exact(sock, _FRAME.size)
+    header = _recv_exact(sock, _FRAME.size, patient)
     length, req_id = _FRAME.unpack(header)
     if length > max_frame:
         raise ConnectionError(f"sidecar frame too large: {length}")
     try:
-        payload = _recv_exact(sock, length)
+        payload = _recv_exact(sock, length, patient)
         if mac_key is not None:
-            mac = _recv_exact(sock, _MAC_LEN)
+            mac = _recv_exact(sock, _MAC_LEN, patient)
             if not hmac.compare_digest(
                 mac, _frame_mac(mac_key, direction, req_id, payload)
             ):
@@ -444,7 +458,9 @@ class SidecarVerifierClient:
         except Exception as exc:
             if self._local is None:
                 raise
-            if isinstance(exc, TimeoutError):
+            if isinstance(exc, TimeoutError) and not isinstance(
+                exc, QueueStallTimeout
+            ):
                 self._mark_suspect()
             logger.error(
                 "sidecar verify failed (%r) — falling back to LOCAL host "
@@ -591,26 +607,27 @@ class SidecarVerifierClient:
 
         def _give_up_queued(reason: str):
             # Budget spent without touching the wire: the socket is healthy,
-            # so concurrent waiters keep it — only this call bows out.
+            # so concurrent waiters keep it — only this call bows out, and
+            # the distinct type keeps verify_batch from marking the sidecar
+            # suspect over what is only local queueing pressure.
             with self._lock:
                 self._pending.pop(req_id, None)
-            return TimeoutError(reason)
+            return QueueStallTimeout(reason)
 
         if not wlock.acquire(timeout=budget):
             raise _give_up_queued(f"sidecar send queue stalled for {budget}s")
         try:
             if waiter["event"].is_set():
                 raise ConnectionError("sidecar connection lost before send")
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
+            if deadline - time.monotonic() <= 0:
                 raise _give_up_queued(
                     f"sidecar send queue stalled for {budget}s"
                 )
-            # Shrink the send window to what's left of the budget (restored
-            # after; the reader tolerates frame-boundary timeouts anyway).
-            # A timeout DURING sendall leaves a partial frame on the wire,
-            # so that path must drop the socket.
-            sock.settimeout(min(remaining, self._timeout))
+            # The send runs under the socket's FIXED timeout (per-call
+            # shrinking would race the reader thread recv'ing on the same
+            # socket mid-frame), so the true worst case is queue-wait +
+            # one socket timeout.  A timeout DURING sendall leaves a
+            # partial frame on the wire, so that path drops the socket.
             try:
                 _write_frame(sock, req_id, payload, mac_key, b"c2s")
             except OSError as exc:
@@ -618,11 +635,6 @@ class SidecarVerifierClient:
                     self._pending.pop(req_id, None)
                 self._drop_socket(sock)
                 raise exc
-            finally:
-                try:
-                    sock.settimeout(self._timeout)
-                except OSError:
-                    pass
         except ConnectionError:
             with self._lock:
                 self._pending.pop(req_id, None)
@@ -647,9 +659,11 @@ class SidecarVerifierClient:
         try:
             while True:
                 try:
-                    req_id, body = _read_frame(sock, _MAX_FRAME, mac_key, b"s2c")
+                    req_id, body = _read_frame(
+                        sock, _MAX_FRAME, mac_key, b"s2c", patient=True
+                    )
                 except TimeoutError:
-                    continue  # idle at a frame boundary (socket timeout)
+                    continue  # unreachable with patient=True; belt-and-braces
                 with self._lock:
                     waiter = self._pending.pop(req_id, None)
                 if waiter is not None:
@@ -692,6 +706,7 @@ class SidecarVerifierClient:
 __all__ = [
     "VerifySidecarServer",
     "SidecarVerifierClient",
+    "QueueStallTimeout",
     "encode_request",
     "decode_request",
 ]

@@ -871,3 +871,244 @@ class TestViewChangeTimeoutBackoff:
         assert controller.changed, "view change did not complete"
         assert vc._backoff_factor == 1, "completion must reset the backoff"
         vc.stop()
+
+
+class TestNewViewMalformedMatrix:
+    """Follower-side NewView + remaining ViewData malformed-input rows.
+
+    Parity: reference viewchanger_test.go TestBadNewViewMessage:702 (wrong
+    leader / wrong view / invalid signature / different last decision /
+    sync / invalid last decision sequence / last decision not set /
+    deliver / not enough) and the TestBadViewDataMessage:479 rows not yet
+    mirrored elsewhere in this file (genesis-behind, wrong last decision
+    view, behind sender)."""
+
+    def _svd(self, signer, data, *, sig=None):
+        from consensus_tpu.wire import SignedViewData, encode_view_data
+
+        return SignedViewData(
+            signer=signer,
+            raw_view_data=encode_view_data(data),
+            signature=sig if sig is not None else b"sig-%d" % signer,
+        )
+
+    def _collecting_vc(self):
+        """Node 2 collecting for view 1 (it leads view 1, no rotation)."""
+        from consensus_tpu.wire import ViewChange as VC
+
+        vc, sched, comm, controller, timer = _make_vc()
+        vc.start(0)
+        for sender in (1, 3, 4):
+            vc.handle_message(sender, VC(next_view=1))
+        sched.advance(0.1)
+        return vc, sched, comm, controller
+
+    def _nv(self, data_by_signer):
+        from consensus_tpu.wire import NewView
+
+        return NewView(signed_view_data=tuple(
+            self._svd(s, d) if not isinstance(d, tuple) else self._svd(s, d[0], sig=d[1])
+            for s, d in data_by_signer
+        ))
+
+    def _sigs(self, ids):
+        return tuple(Signature(id=i, value=b"sig-%d" % i) for i in ids)
+
+    # -- NewView rows (reference TestBadNewViewMessage) ---------------------
+
+    def test_new_view_from_non_leader_sender_ignored(self):
+        """reference row "wrong leader": the NewView sender must be the
+        expected leader of the current view; others are dropped before any
+        content validation."""
+        from consensus_tpu.wire import NewView
+
+        vc, sched, comm, controller, timer = _make_vc()
+        vc.start(0)  # leader of view 0 is node 1
+        data = vd(next_view=0)
+        nv = NewView(signed_view_data=tuple(
+            self._svd(s, data) for s in (1, 3, 4)
+        ))
+        vc.handle_message(3, nv)  # not the leader
+        assert not controller.changed
+        vc.handle_message(1, nv)  # the leader: same content installs
+        assert controller.changed
+        vc.stop()
+
+    def test_new_view_with_wrong_embedded_view_rejected(self):
+        """reference row "wrong view": embedded ViewData for a different
+        next view than the one being installed."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = vd(last_seq=0, next_view=2)
+        vc._process_new_view(self._nv([(1, data), (3, data), (4, data)]))
+        assert not controller.changed
+        vc.stop()
+
+    def test_new_view_with_forged_signature_rejected(self):
+        """reference row "invalid signature"."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = vd(next_view=1)
+        nv = self._nv([(1, data), (3, (data, b"sig-99")), (4, data)])
+        vc._process_new_view(nv)
+        assert not controller.changed
+        vc.stop()
+
+    def test_new_view_same_seq_different_decision_rejected(self):
+        """reference row "different last decision": an embedded last
+        decision at OUR sequence that isn't our decision proves a fork
+        candidate — the whole NewView is refused."""
+        vc, sched, comm, controller = self._collecting_vc()
+        vc._checkpoint.set(proposal_at(1, payload=b"mine"), [])
+        data = ViewData(
+            next_view=1, last_decision=proposal_at(1, payload=b"theirs")
+        )
+        vc._process_new_view(self._nv([(1, data), (3, data), (4, data)]))
+        assert not controller.changed
+        vc.stop()
+
+    def test_new_view_far_ahead_last_decision_triggers_sync(self):
+        """reference row "sync": a last decision more than one ahead means
+        we're behind — request a sync instead of installing."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(2),
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        before = controller.synced
+        vc._process_new_view(self._nv([(1, data), (3, data), (4, data)]))
+        assert controller.synced == before + 1
+        assert not controller.changed
+        vc.stop()
+
+    def test_new_view_last_decision_view_ge_next_view_rejected(self):
+        """reference row "invalid last decision sequence": a last decision
+        claiming a view >= the view being installed is impossible."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = ViewData(
+            next_view=1, last_decision=proposal_at(1, view=1),
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc._process_new_view(self._nv([(1, data), (3, data), (4, data)]))
+        assert not controller.changed
+        assert not controller.delivered
+        vc.stop()
+
+    def test_new_view_missing_last_decision_rejected(self):
+        """reference row "last decision not set"."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = ViewData(next_view=1, last_decision=None)
+        vc._process_new_view(self._nv([(1, data), (3, data), (4, data)]))
+        assert not controller.changed
+        vc.stop()
+
+    def test_new_view_one_ahead_delivers_then_installs(self):
+        """reference row "deliver" (happy variant): a NewView carrying a
+        one-ahead decision with a valid quorum is delivered by US first,
+        then the re-walk finds us caught up and installs."""
+        vc, sched, comm, controller = self._collecting_vc()
+        # Mimic the real application (the controller): deliver advances the
+        # checkpoint — the re-walk loop terminates through it.
+        orig_deliver = controller.deliver
+
+        def deliver(proposal, signatures):
+            out = orig_deliver(proposal, signatures)
+            vc._checkpoint.set(proposal, tuple(signatures))
+            return out
+
+        controller.deliver = deliver
+        decision = proposal_at(1)
+        data = ViewData(
+            next_view=1,
+            last_decision=decision,
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc._process_new_view(self._nv([(1, data), (3, data), (4, data)]))
+        assert [p for p, _ in controller.delivered] == [decision]
+        assert controller.changed, "caught-up follower must install"
+        vc.stop()
+
+    def test_new_view_one_ahead_bad_signature_delivers_but_no_install(self):
+        """reference row "deliver" (exact variant): the carried decision
+        quorum is valid so it IS delivered, but the embedding ViewData's
+        own signature is bad — no install."""
+        vc, sched, comm, controller = self._collecting_vc()
+        orig_deliver = controller.deliver
+
+        def deliver(proposal, signatures):
+            out = orig_deliver(proposal, signatures)
+            vc._checkpoint.set(proposal, tuple(signatures))
+            return out
+
+        controller.deliver = deliver
+        decision = proposal_at(1)
+        data = ViewData(
+            next_view=1,
+            last_decision=decision,
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        nv = self._nv([
+            (1, (data, b"sig-99")), (3, (data, b"sig-99")), (4, (data, b"sig-99")),
+        ])
+        vc._process_new_view(nv)
+        assert [p for p, _ in controller.delivered] == [decision]
+        assert not controller.changed
+        vc.stop()
+
+    def test_new_view_below_quorum_valid_rejected(self):
+        """reference row "not enough": fewer distinct valid ViewData
+        entries than the quorum."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = vd(next_view=1)
+        vc._process_new_view(self._nv([(1, data), (3, data)]))  # 2 < 3
+        assert not controller.changed
+        vc.stop()
+
+    def test_new_view_behind_entries_still_count(self):
+        """Counter-row: entries BEHIND us are fine inside a NewView (the
+        reference accepts them in validateNewViewMsg — only the new
+        leader's ViewData path rejects behind senders)."""
+        vc, sched, comm, controller = self._collecting_vc()
+        vc._checkpoint.set(proposal_at(1), [])
+        mine = ViewData(next_view=1, last_decision=proposal_at(1))
+        behind = vd(next_view=1)  # genesis last decision, seq 0 < our 1
+        nv = self._nv([(1, behind), (3, behind), (4, mine)])
+        vc._process_new_view(nv)
+        assert controller.changed
+        vc.stop()
+
+    # -- remaining ViewData rows (reference TestBadViewDataMessage) ---------
+
+    def test_view_data_genesis_while_leader_ahead_rejected(self):
+        """reference row "genesis": a genesis last decision when the leader
+        has already decided something — the sender is behind."""
+        vc, sched, comm, controller = self._collecting_vc()
+        vc._checkpoint.set(proposal_at(2), [])
+        vc.handle_message(3, self._svd(3, vd(next_view=1)))
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
+
+    def test_view_data_last_decision_view_ge_next_view_rejected(self):
+        """reference row "wrong last decision view"."""
+        vc, sched, comm, controller = self._collecting_vc()
+        data = ViewData(
+            next_view=1, last_decision=proposal_at(1, view=1),
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc.handle_message(3, self._svd(3, data))
+        assert vc._view_data_votes.get(3) is None
+        assert not controller.delivered
+        vc.stop()
+
+    def test_view_data_behind_sender_rejected(self):
+        """reference row adjacency ("the last decision seq is lower"):
+        a sender whose last decision trails the leader's checkpoint cannot
+        vouch for the new view's starting state."""
+        vc, sched, comm, controller = self._collecting_vc()
+        vc._checkpoint.set(proposal_at(2), [])
+        data = ViewData(
+            next_view=1, last_decision=proposal_at(1),
+            last_decision_signatures=self._sigs([1, 3, 4]),
+        )
+        vc.handle_message(3, self._svd(3, data))
+        assert vc._view_data_votes.get(3) is None
+        vc.stop()
