@@ -14,53 +14,68 @@ cd "$(dirname "$0")/.."
 OUT=benchmarks/device_results.jsonl
 COMMIT=$(git rev-parse --short HEAD)
 note() { echo "# $*" >&2; }
-record() {  # record <label> <cmd...>  — runs cmd, tags its JSON line
+stamp_json() {  # stamp_json <label> <json-line>  — tag + append + echo
+  local label=$1 line=$2 stamp
+  stamp=$(date -u +%Y-%m-%dT%H:%M:%SZ)  # per-measurement, not suite-start
+  echo "${line%\}}, \"label\": \"$label\", \"commit\": \"$COMMIT\", \"utc\": \"$stamp\"}" >> "$OUT"
+  echo "$line"
+}
+
+record() {  # record <label> <cmd...>  — runs cmd, tags its FIRST JSON line
   local label=$1; shift
   note "=== $label ==="
-  local line stamp
+  local line
   line=$("$@" 2>>benchmarks/device_suite.log | grep -m1 '^{')
-  stamp=$(date -u +%Y-%m-%dT%H:%M:%SZ)  # per-measurement, not suite-start
   if [ -n "$line" ]; then
-    echo "${line%\}}, \"label\": \"$label\", \"commit\": \"$COMMIT\", \"utc\": \"$stamp\"}" >> "$OUT"
-    echo "$line"
+    stamp_json "$label" "$line"
   else
     note "$label produced no JSON (see benchmarks/device_suite.log)"
   fi
 }
 
+record_stream() {  # record_stream <label> <cmd...>  — tags EVERY JSON line
+  local label=$1; shift
+  note "=== $label ==="
+  "$@" 2>>benchmarks/device_suite.log | while read -r line; do
+    case "$line" in
+      {*) stamp_json "$label" "$line" ;;
+    esac
+  done
+}
+
 # Priority 1: the driver artifact metric (raw engine, both families).
-record bench_ed25519 timeout 1200 python bench.py
-record bench_p256    timeout 1200 python bench.py p256
+record bench_ed25519 timeout -k 10 1200 python bench.py
+record bench_p256    timeout -k 10 1200 python bench.py p256
 
 # Priority 2: device-mode integrated columns at HEAD (in-process coalesced)
 # against the post-reorder host rows (config 3 bar: 999 tx/s / 97 ms p50).
-record cfg3_device timeout 900 python benchmarks/chain_crypto_tps.py \
+record cfg3_device timeout -k 10 900 python benchmarks/chain_crypto_tps.py \
   --family ed25519 --n 7 --batch 1000 --verify device --seconds 15
 
 if [ "${1:-}" = "quick" ]; then exit 0; fi
 
-record north_device timeout 900 python benchmarks/chain_crypto_tps.py \
+record north_device timeout -k 10 900 python benchmarks/chain_crypto_tps.py \
   --family ed25519 --n 10 --batch 1000 --rotate 100 --verify device --seconds 15
-record cfg2_device timeout 900 python benchmarks/chain_crypto_tps.py \
+record cfg2_device timeout -k 10 900 python benchmarks/chain_crypto_tps.py \
   --family p256 --n 4 --batch 500 --verify device --seconds 15
-record cfg4_device timeout 900 python benchmarks/chain_crypto_tps.py \
+record cfg4_device timeout -k 10 900 python benchmarks/chain_crypto_tps.py \
   --family p256 --n 10 --batch 100 --rotate 100 --verify device --seconds 15
 
 # Priority 3: the deployment-shaped number — n processes, one TPU sidecar.
-record mp_cfg3_device timeout 1200 python benchmarks/chain_crypto_mp.py \
+record mp_cfg3_device timeout -k 10 1200 python benchmarks/chain_crypto_mp.py \
   --family ed25519 --n 7 --batch 1000 --verify device --seconds 15
-record mp_north_device timeout 1200 python benchmarks/chain_crypto_mp.py \
+record mp_north_device timeout -k 10 1200 python benchmarks/chain_crypto_mp.py \
   --family ed25519 --n 10 --batch 1000 --rotate 100 --verify device --seconds 15
 
-# Priority 4: the MXU lowering A/B on the real device.
-note "=== mxu_fieldmul (3 lines) ==="
-timeout 1200 python benchmarks/mxu_fieldmul.py --batch 8192 --iters 30 \
-  2>>benchmarks/device_suite.log | while read -r line; do
-    case "$line" in
-      {*) stamp=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-          echo "${line%\}}, \"commit\": \"$COMMIT\", \"utc\": \"$stamp\"}" >> "$OUT"
-          echo "$line" ;;
-    esac
-  done
+# Priority 4: the wave-size boundary behind the P-256 scoped claim
+# (VERDICT r4 #4): smallest wave where the device beats one host core.
+record_stream wave_sweep_p256 timeout -k 10 1800 \
+  python benchmarks/wave_sweep.py --family p256
+record_stream wave_sweep_ed25519 timeout -k 10 1800 \
+  python benchmarks/wave_sweep.py --family ed25519
+
+# Priority 5: the MXU lowering A/B on the real device.
+record_stream mxu_fieldmul timeout -k 10 1200 \
+  python benchmarks/mxu_fieldmul.py --batch 8192 --iters 30
 
 note "device suite done -> $OUT"
