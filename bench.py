@@ -279,6 +279,11 @@ def main() -> None:
         if len(sys.argv) > 1 and sys.argv[1] == "p256"
         else "ed25519_verify_throughput"
     )
+    if os.environ.get("CTPU_PALLAS_SCAN") == "1":
+        # The experimental Pallas-scheduled run reports (and trails) under
+        # its own key — it must never overwrite the headline last-good
+        # number with an A/B experiment's result.
+        metric += "_pallas"
     if not _probe_device_with_retries():
         # Emit the last good measurement as a MACHINE-READABLE block marked
         # stale=true — this run's own value stays 0 (a harness must never

@@ -74,7 +74,13 @@ record_stream wave_sweep_p256 timeout -k 10 1800 \
 record_stream wave_sweep_ed25519 timeout -k 10 1800 \
   python benchmarks/wave_sweep.py --family ed25519
 
-# Priority 5: the MXU lowering A/B on the real device.
+# Priority 5: the whole-scan-in-VMEM Pallas kernel A/B (VERDICT r4 #3) —
+# same bench, scan scheduled by Mosaic instead of XLA.  A Mosaic lowering
+# failure shows up as a missing line + traceback in device_suite.log.
+record bench_ed25519_pallas env CTPU_PALLAS_SCAN=1 timeout -k 10 1800 \
+  python bench.py
+
+# Priority 6: the MXU lowering A/B on the real device.
 record_stream mxu_fieldmul timeout -k 10 1200 \
   python benchmarks/mxu_fieldmul.py --batch 8192 --iters 30
 

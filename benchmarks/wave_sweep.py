@@ -64,6 +64,21 @@ def main() -> None:
     bench.DEVICE_ITERS = args.iters
     bench.HOST_SAMPLE = args.host_sample
 
+    if args.platform != "cpu" and not bench._probe_device_with_retries():
+        # Probe in a subprocess first (bench.py machinery): a wedged tunnel
+        # must fail this sweep in ~2 minutes with a JSON error, not poison
+        # this process and burn the suite's whole timeout slot.
+        print(
+            json.dumps(
+                {
+                    "metric": f"{args.family}_breakeven_wave",
+                    "value": None,
+                    "error": "device unreachable (TPU tunnel wedged)",
+                }
+            )
+        )
+        sys.exit(1)
+
     if args.family == "p256":
         make = bench.make_p256_signatures
     else:

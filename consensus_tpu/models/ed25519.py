@@ -52,6 +52,31 @@ _WINDOWS = 256 // _WINDOW_BITS  # 64
 _TABLE = 9  # signed digits: |d| <= 8 -> multiples 0..8 of (-A)
 
 
+def _pallas_scan_config(batch: int):
+    """(tile, interpret) when the opt-in Pallas scan should be used for a
+    batch of this (static, trace-time) size, else None.
+
+    Opt-in via ``CTPU_PALLAS_SCAN=1`` until the on-device A/B proves a
+    win (VERDICT r4 #3).  Read per trace, so a fresh process controls it
+    with the environment; already-compiled shapes keep their path.
+    Batches that don't tile evenly fall back to the XLA scan — protocol
+    waves are padded to powers of two >= the tile anyway."""
+    import os
+
+    if os.environ.get("CTPU_PALLAS_SCAN", "") != "1":
+        return None
+    tile = int(os.environ.get("CTPU_PALLAS_TILE", "0")) or None
+    if tile is None:
+        from consensus_tpu.ops.pallas_scan import DEFAULT_TILE
+
+        tile = DEFAULT_TILE if batch >= DEFAULT_TILE else batch
+    if batch % tile != 0:
+        return None
+    # Interpret mode on CPU backends: Mosaic is TPU-only; interpret keeps
+    # the CI parity gate runnable everywhere.
+    return tile, jax.default_backend() == "cpu"
+
+
 def verify_impl(
     y_r: jnp.ndarray,       # (32, batch) R.y limbs, uint8 on the wire
     sign_r: jnp.ndarray,    # (batch,)    R.x sign bits
@@ -102,27 +127,40 @@ def verify_impl(
     )
     r_ok, a_ok = pt_ok[..., :batch], pt_ok[..., batch:]
     neg_a = ed.negate(a_point)
-    # The table coords inherit the inputs' sharding variance so the scan
-    # carry type-checks under shard_map.
-    a_table = ed.multiples_table(neg_a, _TABLE)
+    pallas_cfg = _pallas_scan_config(batch)
+    if pallas_cfg is not None:
+        # Opt-in whole-scan-in-VMEM Pallas kernel (CTPU_PALLAS_SCAN=1):
+        # same arithmetic, different scheduling — see ops/pallas_scan.py.
+        tile, interpret = pallas_cfg
+        from consensus_tpu.ops.pallas_scan import horner_scan
 
-    lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]  # (9, 1)
-
-    def step(acc: ed.Point, k_w):
-        d = k_w - 8                 # signed digit in [-8, 7]
-        k_oh = (jnp.abs(d)[None] == lanes).astype(jnp.float32)  # (9, batch)
-        # 3 T-free doubles as an inner scan (one body in the graph) + the
-        # final T-producing double — graph size, not runtime, economy.
-        acc, _ = jax.lax.scan(
-            lambda a, _: (ed.double(a, need_t=False), None), acc, None, length=3
+        acc = horner_scan(
+            neg_a.x, neg_a.y, neg_a.z, neg_a.t, k_digits,
+            tile=tile, interpret=interpret,
         )
-        acc = ed.double(acc)
-        q = ed.table_lookup(a_table, k_oh)
-        q = ed.select(d < 0, ed.negate(q), q)  # two field subs, no muls
-        acc = ed.add(acc, q)
-        return acc, None
+    else:
+        # The table coords inherit the inputs' sharding variance so the
+        # scan carry type-checks under shard_map.
+        a_table = ed.multiples_table(neg_a, _TABLE)
 
-    acc, _ = jax.lax.scan(step, ed.identity_like(y_r), k_digits)
+        lanes = jnp.arange(_TABLE, dtype=jnp.int32)[:, None]  # (9, 1)
+
+        def step(acc: ed.Point, k_w):
+            d = k_w - 8             # signed digit in [-8, 7]
+            k_oh = (jnp.abs(d)[None] == lanes).astype(jnp.float32)  # (9, batch)
+            # 3 T-free doubles as an inner scan (one body in the graph) +
+            # the final T-producing double — graph size, not runtime,
+            # economy.
+            acc, _ = jax.lax.scan(
+                lambda a, _: (ed.double(a, need_t=False), None), acc, None, length=3
+            )
+            acc = ed.double(acc)
+            q = ed.table_lookup(a_table, k_oh)
+            q = ed.select(d < 0, ed.negate(q), q)  # two field subs, no muls
+            acc = ed.add(acc, q)
+            return acc, None
+
+        acc, _ = jax.lax.scan(step, ed.identity_like(y_r), k_digits)
     acc = ed.add(acc, ed.fixed_base_mul_comb(s_digits8))
 
     return host_ok & r_ok & a_ok & ed.equal(acc, r_point)

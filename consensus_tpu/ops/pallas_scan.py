@@ -1,0 +1,181 @@
+"""Whole-scan-in-VMEM Pallas kernel for the Ed25519 Horner scan.
+
+The deferred round-3 experiment (BASELINE.md cost model; VERDICT r4 #3):
+the [k](-A) double-scalar half of the verifier is 64 steps of 4 doubles +
+1 table add over (32, batch) f32 limb tensors.  Under plain XLA this is a
+``lax.scan`` whose carry (a 4-coordinate extended point, 512 B/lane) and
+whose per-step intermediates live wherever XLA schedules them — any HBM
+round trip between steps is pure overhead, since the arithmetic itself is
+lane-local VPU work.  This kernel pins ONE batch tile's entire scan in
+VMEM: the 9-entry per-batch table (~590 KB at tile 128) is built in
+registers/VMEM, the 64-step loop runs to completion, and only the final
+accumulator returns to HBM — HBM traffic becomes one read of the inputs
+plus one write of the result, independent of step count.
+
+The field/point arithmetic is the SAME code the XLA path uses
+(:mod:`consensus_tpu.ops.field25519`, :mod:`consensus_tpu.ops.ed25519`) —
+Pallas kernel bodies trace ordinary jax.numpy, so both paths share one
+bit-exact implementation and the A/B compares *scheduling*, not math.
+
+Correctness is CI-gated in interpret mode (tests/test_pallas_scan.py);
+the Mosaic lowering + speed verdict needs the real device — the suite
+records ``env CTPU_PALLAS_SCAN=1 python bench.py`` next to the XLA
+number (benchmarks/run_device_suite.sh, priority 5).  The scan stays
+opt-in (``CTPU_PALLAS_SCAN=1``) until that A/B proves a win.
+
+Reference context: this accelerates the commit-signature sweep the
+reference runs as a sequential per-goroutine CPU loop
+(reference internal/bft/view.go:537-541).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from consensus_tpu.ops import ed25519 as ed
+from consensus_tpu.ops import field25519 as fe
+
+#: Lane tile: the TPU vector lane width is 128; larger tiles amortize the
+#: per-program table build (7 point adds) over more lanes at the cost of
+#: VMEM (~4.6 KB/lane for the table).
+DEFAULT_TILE = 128
+
+_TABLE = 9  # |signed digit| <= 8 -> multiples 0..8 of (-A)
+_WINDOWS = 64
+
+
+def _const_bank_np() -> np.ndarray:
+    """The three (32,) field constants the point formulas reach for —
+    1 (identity coords), d2 (the add formula), and 2p (subtraction bias).
+    Pallas forbids captured array constants in kernel bodies, so they ride
+    in as one (3, 32) input instead."""
+    return np.stack(
+        [fe.int_to_limbs(1), fe.int_to_limbs(fe.D2), fe._TWO_P.copy()]
+    ).astype(np.float32)
+
+
+@contextlib.contextmanager
+def _inject_consts(bank: jnp.ndarray):
+    """During kernel tracing, point field25519's constant plumbing at the
+    in-kernel bank rows: ``constant_like`` looks its value up, and the 2p
+    subtraction bias global becomes the traced row.  Restored on exit —
+    the XLA path keeps its baked numpy constants."""
+    lookup = {1: bank[0], fe.D2: bank[1]}
+    orig_constant_like = fe.constant_like
+    orig_two_p = fe._TWO_P
+
+    def traced_constant_like(value: int, like: jnp.ndarray) -> jnp.ndarray:
+        row = lookup.get(value % fe.P)
+        if row is None:  # pragma: no cover — scan body only uses 1 and d2
+            raise ValueError(
+                f"pallas scan body needs constant {value} not in the bank"
+            )
+        return like * 0 + jnp.reshape(row, (fe.LIMBS,) + (1,) * (like.ndim - 1))
+
+    fe.constant_like = traced_constant_like
+    fe._TWO_P = bank[2]
+    try:
+        yield
+    finally:
+        fe.constant_like = orig_constant_like
+        fe._TWO_P = orig_two_p
+
+
+def _scan_kernel(consts_ref, kd_ref, ax_ref, ay_ref, az_ref, at_ref,
+                 ox_ref, oy_ref, oz_ref, ot_ref):
+    """One batch tile: build the 9-entry table, run all 64 Horner steps,
+    write the accumulator.  Everything between the refs lives in VMEM."""
+    neg_a = ed.Point(ax_ref[...], ay_ref[...], az_ref[...], at_ref[...])
+    kd = kd_ref[...]  # (64, tile) int32, digit + 8, MSB window first
+
+    with _inject_consts(consts_ref[...]):
+        # j * (-A) for j = 0..8 as an unrolled Python list — each entry is
+        # a VMEM-resident value, and the adds trace inline (9 is small).
+        table = [ed.identity_like(neg_a.x), neg_a]
+        for _ in range(_TABLE - 2):
+            table.append(ed.add(table[-1], neg_a))
+
+        def lookup(d_abs: jnp.ndarray) -> ed.Point:
+            # One-hot contraction over the 9 entries (no gather): d_abs is
+            # (1, tile); each mask broadcasts against (32, tile) coords.
+            coords = []
+            for sel in ("x", "y", "z", "t"):
+                acc = None
+                for j, entry in enumerate(table):
+                    mask = (d_abs == j).astype(jnp.float32)  # (1, tile)
+                    term = getattr(entry, sel) * mask
+                    acc = term if acc is None else acc + term
+                coords.append(acc)
+            return ed.Point(*coords)
+
+        def step(i, carry):
+            acc = ed.Point(*carry)
+            d = jax.lax.dynamic_slice_in_dim(kd, i, 1, axis=0) - 8  # (1, tile)
+            for _ in range(3):
+                acc = ed.double(acc, need_t=False)
+            acc = ed.double(acc)
+            q = lookup(jnp.abs(d))
+            q = ed.select(d[0] < 0, ed.negate(q), q)
+            acc = ed.add(acc, q)
+            return (acc.x, acc.y, acc.z, acc.t)
+
+        ident = ed.identity_like(neg_a.x)
+        x, y, z, t = jax.lax.fori_loop(
+            0, _WINDOWS, step, (ident.x, ident.y, ident.z, ident.t)
+        )
+    ox_ref[...] = x
+    oy_ref[...] = y
+    oz_ref[...] = z
+    ot_ref[...] = t
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def horner_scan(
+    neg_a_x: jnp.ndarray,   # (32, batch) f32 — the four (-A) coordinates
+    neg_a_y: jnp.ndarray,
+    neg_a_z: jnp.ndarray,
+    neg_a_t: jnp.ndarray,
+    k_digits: jnp.ndarray,  # (64, batch) int32, digit + 8, MSB first
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+) -> ed.Point:
+    """[k](-A) for the whole batch via one Pallas grid over batch tiles.
+
+    Drop-in for the ``lax.scan`` half of
+    :func:`consensus_tpu.models.ed25519.verify_impl`; the fixed-base comb
+    and the final add/compare stay in XLA (the comb's constant-table
+    lookups are MXU matmuls — already where they belong).
+    """
+    batch = neg_a_x.shape[-1]
+    if batch % tile != 0:
+        raise ValueError(f"batch {batch} not divisible by tile {tile}")
+    grid = (batch // tile,)
+    consts_spec = pl.BlockSpec((3, fe.LIMBS), lambda i: (0, 0))
+    coord_spec = pl.BlockSpec((fe.LIMBS, tile), lambda i: (0, i))
+    digit_spec = pl.BlockSpec((_WINDOWS, tile), lambda i: (0, i))
+    out_shape = jax.ShapeDtypeStruct((fe.LIMBS, batch), jnp.float32)
+    x, y, z, t = pl.pallas_call(
+        _scan_kernel,
+        grid=grid,
+        in_specs=[consts_spec, digit_spec,
+                  coord_spec, coord_spec, coord_spec, coord_spec],
+        out_specs=[coord_spec] * 4,
+        out_shape=[out_shape] * 4,
+        interpret=interpret,
+    )(
+        jnp.asarray(_const_bank_np()),
+        k_digits.astype(jnp.int32),
+        neg_a_x, neg_a_y, neg_a_z, neg_a_t,
+    )
+    return ed.Point(x=x, y=y, z=z, t=t)
+
+
+__all__ = ["horner_scan", "DEFAULT_TILE"]
