@@ -22,6 +22,7 @@ so XLA compiles a handful of shapes once and reuses them forever.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import hashlib
 from typing import Optional, Sequence
@@ -59,11 +60,14 @@ def _pallas_scan_config(batch: int):
     Opt-in via ``CTPU_PALLAS_SCAN=1`` until the on-device A/B proves a
     win (VERDICT r4 #3).  Read per trace, so a fresh process controls it
     with the environment; already-compiled shapes keep their path.
-    Batches that don't tile evenly fall back to the XLA scan — protocol
-    waves are padded to powers of two >= the tile anyway."""
+
+    A batch that cannot tile evenly under the explicit opt-in is an
+    ERROR, not a silent XLA fallback — a fallback would let the A/B
+    record a pure-XLA number under the pallas metric key and read as
+    "no difference" while the kernel never ran."""
     import os
 
-    if os.environ.get("CTPU_PALLAS_SCAN", "") != "1":
+    if os.environ.get("CTPU_PALLAS_SCAN", "") != "1" or _PALLAS_SUPPRESSED:
         return None
     tile = int(os.environ.get("CTPU_PALLAS_TILE", "0")) or None
     if tile is None:
@@ -71,10 +75,33 @@ def _pallas_scan_config(batch: int):
 
         tile = DEFAULT_TILE if batch >= DEFAULT_TILE else batch
     if batch % tile != 0:
-        return None
+        raise ValueError(
+            f"CTPU_PALLAS_SCAN=1 but batch {batch} does not tile by "
+            f"{tile}; fix CTPU_PALLAS_TILE or pad the batch — refusing a "
+            "silent XLA fallback that would invalidate the A/B"
+        )
     # Interpret mode on CPU backends: Mosaic is TPU-only; interpret keeps
     # the CI parity gate runnable everywhere.
     return tile, jax.default_backend() == "cpu"
+
+
+#: Set True around traces where pallas_call must not appear (the
+#: shard_map multi-chip path — pallas-under-shard_map is unvalidated and
+#: per-shard batch sizes would change the tiling decision anyway).
+_PALLAS_SUPPRESSED = False
+
+
+@contextlib.contextmanager
+def suppress_pallas_scan():
+    """Disable the opt-in Pallas scan for traces inside this context
+    (used by the sharded verifier; see _pallas_scan_config)."""
+    global _PALLAS_SUPPRESSED
+    prev = _PALLAS_SUPPRESSED
+    _PALLAS_SUPPRESSED = True
+    try:
+        yield
+    finally:
+        _PALLAS_SUPPRESSED = prev
 
 
 def verify_impl(

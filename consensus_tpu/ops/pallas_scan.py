@@ -105,6 +105,10 @@ def _scan_kernel(consts_ref, kd_ref, ax_ref, ay_ref, az_ref, at_ref,
         def lookup(d_abs: jnp.ndarray) -> ed.Point:
             # One-hot contraction over the 9 entries (no gather): d_abs is
             # (1, tile); each mask broadcasts against (32, tile) coords.
+            # Deliberately NOT ed.table_lookup: that helper wants rank-3
+            # stacked coords, and this kernel stays rank-2 end-to-end to
+            # minimize Mosaic lowering risk (the whole experiment).  If
+            # table_lookup's semantics ever change, re-sync here.
             coords = []
             for sel in ("x", "y", "z", "t"):
                 acc = None

@@ -77,7 +77,13 @@ def sharded_verify_fn(mesh: Mesh):
         out_specs=(P(BATCH_AXIS), P()),
     )
     def _shard(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok):
-        ok = verify_impl(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok)
+        from consensus_tpu.models.ed25519 import suppress_pallas_scan
+
+        # pallas_call-under-shard_map is unvalidated (and per-shard batch
+        # sizes would change the tiling decision): the multi-chip path
+        # always traces the XLA scan, opt-in flag or not.
+        with suppress_pallas_scan():
+            ok = verify_impl(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok)
         total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
         return ok, total
 

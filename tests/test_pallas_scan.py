@@ -109,16 +109,10 @@ def test_pallas_scan_zero_and_small_digits():
     ).all()
 
 
-def test_full_verifier_parity_with_pallas_flag(monkeypatch):
-    """End-to-end: verify_batch with CTPU_PALLAS_SCAN=1 (interpret mode on
-    CPU) accepts valid signatures and rejects tampered ones, matching the
-    default path bit-for-bit on the same inputs."""
+def _test_corpus(n=8):
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
-    import consensus_tpu.models.ed25519 as model
-
-    n = 8
     msgs, sigs, keys = [], [], []
     for i in range(n):
         sk = Ed25519PrivateKey.from_private_bytes(bytes([i + 1] * 32))
@@ -130,7 +124,21 @@ def test_full_verifier_parity_with_pallas_flag(monkeypatch):
         sigs.append(sk.sign(m))
         keys.append(pk)
     sigs[3] = sigs[3][:32] + bytes(32)  # corrupt one S half
-    expected = [True, True, True, False, True, True, True, True]
+    return msgs, sigs, keys, [i != 3 for i in range(n)]
+
+
+def test_full_verifier_parity_with_pallas_flag(monkeypatch):
+    """End-to-end A/B on identical inputs: verify_batch with
+    CTPU_PALLAS_SCAN=1 (interpret mode on CPU) returns the SAME verdict
+    vector as the default XLA path, and both match the known
+    accept/reject pattern (one tampered signature)."""
+    import consensus_tpu.models.ed25519 as model
+
+    msgs, sigs, keys, expected = _test_corpus()
+
+    baseline = list(
+        np.asarray(model.Ed25519BatchVerifier().verify_batch(msgs, sigs, keys))
+    )
 
     monkeypatch.setenv("CTPU_PALLAS_SCAN", "1")
     monkeypatch.setenv("CTPU_PALLAS_TILE", "8")
@@ -140,4 +148,35 @@ def test_full_verifier_parity_with_pallas_flag(monkeypatch):
     monkeypatch.setattr(model, "_verify_kernel", fresh)
     verifier = model.Ed25519BatchVerifier()
     out = list(np.asarray(verifier.verify_batch(msgs, sigs, keys)))
+    assert out == expected
+    assert out == baseline
+
+
+def test_misconfigured_tile_fails_loud(monkeypatch):
+    """An opt-in whose batch cannot tile must ERROR, not silently fall
+    back to XLA — a fallback would let the device A/B record a pure-XLA
+    number under the pallas metric key."""
+    import consensus_tpu.models.ed25519 as model
+
+    monkeypatch.setenv("CTPU_PALLAS_SCAN", "1")
+    monkeypatch.setenv("CTPU_PALLAS_TILE", "7")
+    with pytest.raises(ValueError, match="does not tile"):
+        model._pallas_scan_config(8)
+
+
+def test_sharded_path_suppresses_pallas(monkeypatch):
+    """The multi-chip shard_map path must keep tracing the XLA scan even
+    with the env opt-in set (pallas-under-shard_map is unvalidated); the
+    sharded verifier still produces correct verdicts with the flag on."""
+    import consensus_tpu.models.ed25519 as model
+    from consensus_tpu.parallel.sharding import ShardedEd25519Verifier, make_mesh
+
+    monkeypatch.setenv("CTPU_PALLAS_SCAN", "1")
+    # No CTPU_PALLAS_TILE: per-shard batches would tile fine, so only the
+    # suppression keeps pallas out of the shard body.
+    msgs, sigs, keys, expected = _test_corpus()
+    mesh = make_mesh(jax.devices()[:2])
+    out = list(
+        np.asarray(ShardedEd25519Verifier(mesh=mesh).verify_batch(msgs, sigs, keys))
+    )
     assert out == expected

@@ -705,3 +705,34 @@ def test_late_durability_on_aborted_view_stays_silent():
         if cb is not None:
             cb()
     assert h.comm.broadcasts == [], "aborted view uttered a stale-view vote"
+
+
+def test_corrupt_metadata_bytes_rejected():
+    """Undecodable metadata in a leader proposal must abort + complain, not
+    crash the replica.  Parity: reference view_test.go TestBadPrePrepare
+    row "corrupt metadata in proposal"."""
+    h = Harness()
+    tampered = Proposal(payload=b"x", metadata=b"\x01\x02\x03")
+    h.view.handle_message(1, h.pre_prepare(tampered))
+    assert h.view.phase == Phase.ABORT
+    assert h.fd.complaints
+    assert h.state.saved == []
+
+
+def test_metadata_sequence_mismatch_rejected():
+    """Metadata claiming the wrong proposal sequence is a bad proposal.
+    Parity: reference view_test.go TestBadPrePrepare row "wrong proposal
+    sequence in metadata"."""
+    from consensus_tpu.wire import ViewMetadata, encode_view_metadata
+
+    h = Harness()
+    tampered = Proposal(
+        payload=b"x",
+        metadata=encode_view_metadata(
+            ViewMetadata(view_id=0, latest_sequence=7)
+        ),
+    )
+    h.view.handle_message(1, h.pre_prepare(tampered))
+    assert h.view.phase == Phase.ABORT
+    assert h.fd.complaints
+    assert h.state.saved == []
