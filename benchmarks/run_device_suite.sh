@@ -79,6 +79,8 @@ record_stream wave_sweep_ed25519 timeout -k 10 1800 \
 # failure shows up as a missing line + traceback in device_suite.log.
 record bench_ed25519_pallas env CTPU_PALLAS_SCAN=1 timeout -k 10 1800 \
   python bench.py
+record bench_p256_pallas env CTPU_PALLAS_SCAN=1 timeout -k 10 1800 \
+  python bench.py p256
 
 # Priority 6: the MXU lowering A/B on the real device.
 record_stream mxu_fieldmul timeout -k 10 1200 \

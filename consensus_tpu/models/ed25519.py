@@ -22,8 +22,6 @@ so XLA compiles a handful of shapes once and reuses them forever.
 
 from __future__ import annotations
 
-import contextlib
-import functools
 import hashlib
 from typing import Optional, Sequence
 
@@ -53,55 +51,23 @@ _WINDOWS = 256 // _WINDOW_BITS  # 64
 _TABLE = 9  # signed digits: |d| <= 8 -> multiples 0..8 of (-A)
 
 
+# Shared opt-in plumbing for the whole-scan-in-VMEM experiment (both curve
+# families) lives in consensus_tpu/ops/pallas_scan.py; these thin wrappers
+# keep the import LAZY — importing jax.experimental.pallas costs ~1 s of
+# process cold-start, which every replica process would pay for a
+# default-off experiment (the 1-core box runs n of them).
+
+
 def _pallas_scan_config(batch: int):
-    """(tile, interpret) when the opt-in Pallas scan should be used for a
-    batch of this (static, trace-time) size, else None.
+    from consensus_tpu.ops.pallas_scan import scan_config
 
-    Opt-in via ``CTPU_PALLAS_SCAN=1`` until the on-device A/B proves a
-    win (VERDICT r4 #3).  Read per trace, so a fresh process controls it
-    with the environment; already-compiled shapes keep their path.
-
-    A batch that cannot tile evenly under the explicit opt-in is an
-    ERROR, not a silent XLA fallback — a fallback would let the A/B
-    record a pure-XLA number under the pallas metric key and read as
-    "no difference" while the kernel never ran."""
-    import os
-
-    if os.environ.get("CTPU_PALLAS_SCAN", "") != "1" or _PALLAS_SUPPRESSED:
-        return None
-    tile = int(os.environ.get("CTPU_PALLAS_TILE", "0")) or None
-    if tile is None:
-        from consensus_tpu.ops.pallas_scan import DEFAULT_TILE
-
-        tile = DEFAULT_TILE if batch >= DEFAULT_TILE else batch
-    if batch % tile != 0:
-        raise ValueError(
-            f"CTPU_PALLAS_SCAN=1 but batch {batch} does not tile by "
-            f"{tile}; fix CTPU_PALLAS_TILE or pad the batch — refusing a "
-            "silent XLA fallback that would invalidate the A/B"
-        )
-    # Interpret mode on CPU backends: Mosaic is TPU-only; interpret keeps
-    # the CI parity gate runnable everywhere.
-    return tile, jax.default_backend() == "cpu"
+    return scan_config(batch)
 
 
-#: Set True around traces where pallas_call must not appear (the
-#: shard_map multi-chip path — pallas-under-shard_map is unvalidated and
-#: per-shard batch sizes would change the tiling decision anyway).
-_PALLAS_SUPPRESSED = False
-
-
-@contextlib.contextmanager
 def suppress_pallas_scan():
-    """Disable the opt-in Pallas scan for traces inside this context
-    (used by the sharded verifier; see _pallas_scan_config)."""
-    global _PALLAS_SUPPRESSED
-    prev = _PALLAS_SUPPRESSED
-    _PALLAS_SUPPRESSED = True
-    try:
-        yield
-    finally:
-        _PALLAS_SUPPRESSED = prev
+    from consensus_tpu.ops.pallas_scan import suppress_pallas_scan as real
+
+    return real()
 
 
 def verify_impl(

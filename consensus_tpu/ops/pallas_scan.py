@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 
 import numpy as np
 
@@ -41,14 +42,64 @@ from jax.experimental import pallas as pl
 
 from consensus_tpu.ops import ed25519 as ed
 from consensus_tpu.ops import field25519 as fe
+from consensus_tpu.ops import field_p256 as fp
+from consensus_tpu.ops import p256
 
 #: Lane tile: the TPU vector lane width is 128; larger tiles amortize the
 #: per-program table build (7 point adds) over more lanes at the cost of
 #: VMEM (~4.6 KB/lane for the table).
 DEFAULT_TILE = 128
 
-_TABLE = 9  # |signed digit| <= 8 -> multiples 0..8 of (-A)
+_TABLE = 9  # |signed digit| <= 8 -> multiples 0..8 of the variable point
 _WINDOWS = 64
+_WINDOWS_P256 = 65  # incl. the signed-recoding carry window
+
+
+#: Set True around traces where pallas_call must not appear (the
+#: shard_map multi-chip path — pallas-under-shard_map is unvalidated and
+#: per-shard batch sizes would change the tiling decision anyway).
+_SUPPRESSED = False
+
+
+@contextlib.contextmanager
+def suppress_pallas_scan():
+    """Disable the opt-in Pallas scan for traces inside this context
+    (used by the sharded verifiers; see :func:`scan_config`)."""
+    global _SUPPRESSED
+    prev = _SUPPRESSED
+    _SUPPRESSED = True
+    try:
+        yield
+    finally:
+        _SUPPRESSED = prev
+
+
+def scan_config(batch: int):
+    """(tile, interpret) when the opt-in Pallas scan should be used for a
+    batch of this (static, trace-time) size, else None.
+
+    Opt-in via ``CTPU_PALLAS_SCAN=1`` until the on-device A/B proves a
+    win (VERDICT r4 #3).  Read per trace, so a fresh process controls it
+    with the environment; already-compiled shapes keep their path.
+
+    A batch that cannot tile evenly under the explicit opt-in is an
+    ERROR, not a silent XLA fallback — a fallback would let the A/B
+    record a pure-XLA number under the pallas metric key and read as
+    "no difference" while the kernel never ran."""
+    if os.environ.get("CTPU_PALLAS_SCAN", "") != "1" or _SUPPRESSED:
+        return None
+    tile = int(os.environ.get("CTPU_PALLAS_TILE", "0")) or None
+    if tile is None:
+        tile = DEFAULT_TILE if batch >= DEFAULT_TILE else batch
+    if batch % tile != 0:
+        raise ValueError(
+            f"CTPU_PALLAS_SCAN=1 but batch {batch} does not tile by "
+            f"{tile}; fix CTPU_PALLAS_TILE or pad the batch — refusing a "
+            "silent XLA fallback that would invalidate the A/B"
+        )
+    # Interpret mode on CPU backends: Mosaic is TPU-only; interpret keeps
+    # the CI parity gate runnable everywhere.
+    return tile, jax.default_backend() == "cpu"
 
 
 def _const_bank_np() -> np.ndarray:
@@ -182,4 +233,136 @@ def horner_scan(
     return ed.Point(x=x, y=y, z=z, t=t)
 
 
-__all__ = ["horner_scan", "DEFAULT_TILE"]
+# --- P-256 variant ----------------------------------------------------------
+
+
+def _const_bank_p256_np() -> np.ndarray:
+    """(2, 32) bank: the field constants the P-256 formulas reach for —
+    1 (identity/affine z) and the curve b (add/double)."""
+    return np.stack(
+        [fp.int_to_limbs(1), fp.int_to_limbs(p256.B)]
+    ).astype(np.float32)
+
+
+@contextlib.contextmanager
+def _inject_consts_p256(bank: jnp.ndarray, solinas: jnp.ndarray,
+                        bias: jnp.ndarray):
+    """P-256 analogue of :func:`_inject_consts`: the Solinas reduction
+    matrix (every mul/square/add), the signed subtraction bias, and the
+    value constants become traced kernel inputs for the duration."""
+    lookup = {1: bank[0], p256.B % fp.P: bank[1]}
+    orig_constant_like = fp.constant_like
+    orig_solinas = fp._SOLINAS_M
+    orig_bias = fp._BIAS
+
+    def traced_constant_like(value: int, like: jnp.ndarray) -> jnp.ndarray:
+        row = lookup.get(value % fp.P)
+        if row is None:  # pragma: no cover — scan body only uses 1 and b
+            raise ValueError(
+                f"pallas p256 scan body needs constant {value} not in bank"
+            )
+        return like * 0 + jnp.reshape(row, (fp.LIMBS,) + (1,) * (like.ndim - 1))
+
+    fp.constant_like = traced_constant_like
+    fp._SOLINAS_M = solinas
+    fp._BIAS = bias
+    try:
+        yield
+    finally:
+        fp.constant_like = orig_constant_like
+        fp._SOLINAS_M = orig_solinas
+        fp._BIAS = orig_bias
+
+
+def _scan_kernel_p256(consts_ref, solinas_ref, bias_ref, kd_ref,
+                      qx_ref, qy_ref, ox_ref, oy_ref, oz_ref):
+    """One batch tile of the [u2]Q Horner scan: 9-entry table + 65 windows
+    (incl. the recoding carry), all intermediates in VMEM."""
+    kd = kd_ref[...]  # (65, tile) int32, digit + 8, MSB window first
+    with _inject_consts_p256(
+        consts_ref[...], solinas_ref[...], bias_ref[0]
+    ):
+        q = p256.affine_like(qx_ref[...], qy_ref[...])
+        table = [p256.identity_like(q.x), q]
+        for _ in range(_TABLE - 2):
+            table.append(p256.add(table[-1], q))
+
+        def lookup(d_abs: jnp.ndarray) -> p256.Point:
+            # Rank-2-only one-hot contraction (see the ed25519 kernel's
+            # note on Mosaic lowering risk).
+            coords = []
+            for sel in ("x", "y", "z"):
+                acc = None
+                for j, entry in enumerate(table):
+                    mask = (d_abs == j).astype(jnp.float32)  # (1, tile)
+                    term = getattr(entry, sel) * mask
+                    acc = term if acc is None else acc + term
+                coords.append(acc)
+            return p256.Point(*coords)
+
+        def step(i, carry):
+            acc = p256.Point(*carry)
+            d = jax.lax.dynamic_slice_in_dim(kd, i, 1, axis=0) - 8
+            for _ in range(4):
+                acc = p256.double(acc)
+            t = lookup(jnp.abs(d))
+            t = p256.select(d[0] < 0, p256.negate(t), t)
+            acc = p256.add(acc, t)
+            return (acc.x, acc.y, acc.z)
+
+        ident = p256.identity_like(q.x)
+        x, y, z = jax.lax.fori_loop(
+            0, _WINDOWS_P256, step, (ident.x, ident.y, ident.z)
+        )
+    ox_ref[...] = x
+    oy_ref[...] = y
+    oz_ref[...] = z
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def horner_scan_p256(
+    qx: jnp.ndarray,        # (32, batch) f32 — Q affine coordinates
+    qy: jnp.ndarray,
+    u2_digits: jnp.ndarray, # (65, batch) int32, digit + 8, MSB first
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+) -> p256.Point:
+    """[u2]Q for the whole batch — the P-256 counterpart of
+    :func:`horner_scan` (the [u1]G comb and the x ≡ r check stay in XLA).
+    """
+    batch = qx.shape[-1]
+    if batch % tile != 0:
+        raise ValueError(f"batch {batch} not divisible by tile {tile}")
+    grid = (batch // tile,)
+    consts_spec = pl.BlockSpec((2, fp.LIMBS), lambda i: (0, 0))
+    solinas_spec = pl.BlockSpec(fp._SOLINAS_M.shape, lambda i: (0, 0))
+    bias_spec = pl.BlockSpec((1, fp.LIMBS), lambda i: (0, 0))
+    coord_spec = pl.BlockSpec((fp.LIMBS, tile), lambda i: (0, i))
+    digit_spec = pl.BlockSpec((_WINDOWS_P256, tile), lambda i: (0, i))
+    out_shape = jax.ShapeDtypeStruct((fp.LIMBS, batch), jnp.float32)
+    x, y, z = pl.pallas_call(
+        _scan_kernel_p256,
+        grid=grid,
+        in_specs=[consts_spec, solinas_spec, bias_spec, digit_spec,
+                  coord_spec, coord_spec],
+        out_specs=[coord_spec] * 3,
+        out_shape=[out_shape] * 3,
+        interpret=interpret,
+    )(
+        jnp.asarray(_const_bank_p256_np()),
+        jnp.asarray(fp._SOLINAS_M, dtype=jnp.float32),
+        jnp.asarray(fp._get_bias(), dtype=jnp.float32)[None],
+        u2_digits.astype(jnp.int32),
+        qx, qy,
+    )
+    return p256.Point(x=x, y=y, z=z)
+
+
+__all__ = [
+    "horner_scan",
+    "horner_scan_p256",
+    "scan_config",
+    "suppress_pallas_scan",
+    "DEFAULT_TILE",
+]

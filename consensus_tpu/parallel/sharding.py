@@ -159,7 +159,11 @@ def sharded_p256_verify_fn(mesh: Mesh):
         out_specs=(P(BATCH_AXIS), P()),
     )
     def _shard(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok):
-        ok = p256_verify_impl(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok)
+        from consensus_tpu.ops.pallas_scan import suppress_pallas_scan
+
+        # Same rule as the Ed25519 shard: no pallas_call under shard_map.
+        with suppress_pallas_scan():
+            ok = p256_verify_impl(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok)
         total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
         return ok, total
 

@@ -180,3 +180,107 @@ def test_sharded_path_suppresses_pallas(monkeypatch):
         np.asarray(ShardedEd25519Verifier(mesh=mesh).verify_batch(msgs, sigs, keys))
     )
     assert out == expected
+
+
+# --- P-256 variant ---------------------------------------------------------
+
+
+def _p256_case(n, seed=11):
+    from consensus_tpu.ops import field_p256 as fp
+    from consensus_tpu.ops import p256
+
+    rng = np.random.default_rng(seed)
+    pts, cur = [], (p256.GX, p256.GY)
+    for _ in range(n):
+        pts.append(cur)
+        cur = p256._add_int(cur, (p256.GX, p256.GY))
+    xs = np.stack([fp.int_to_limbs(x) for x, _ in pts], axis=1)
+    ys = np.stack([fp.int_to_limbs(y) for _, y in pts], axis=1)
+    scalars = [int.from_bytes(rng.bytes(32), "big") % p256.N for _ in range(n)]
+    return jnp.asarray(xs), jnp.asarray(ys), scalars
+
+
+def _p256_xla_reference(qx, qy, u2_digits):
+    from consensus_tpu.ops import p256
+
+    q = p256.affine_like(qx, qy)
+    table = p256.multiples_table(q, 9)
+    lanes = jnp.arange(9, dtype=jnp.int32)[:, None]
+
+    def step(acc, w):
+        d = w - 8
+        oh2 = (jnp.abs(d)[None] == lanes).astype(jnp.float32)
+        for _ in range(4):
+            acc = p256.double(acc)
+        t = p256.table_lookup(table, oh2)
+        t = p256.select(d < 0, p256.negate(t), t)
+        acc = p256.add(acc, t)
+        return acc, None
+
+    acc, _ = jax.lax.scan(step, p256.identity_like(qx), u2_digits)
+    return acc
+
+
+def test_pallas_p256_scan_matches_xla_reference():
+    from consensus_tpu.models.ecdsa_p256 import _scalars_to_signed_window_digits
+    from consensus_tpu.ops import field_p256 as fp
+    from consensus_tpu.ops import p256
+    from consensus_tpu.ops.pallas_scan import horner_scan_p256
+
+    n = 4
+    qx, qy, scalars = _p256_case(n)
+    kd = jnp.asarray(
+        _scalars_to_signed_window_digits(scalars).astype(np.int32)
+    )
+    got = horner_scan_p256(qx, qy, kd, tile=2, interpret=True)
+    want = _p256_xla_reference(qx, qy, kd)
+    # Projective equality: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1.
+    eq_x = fp.eq(fp.mul(got.x, want.z), fp.mul(want.x, got.z))
+    eq_y = fp.eq(fp.mul(got.y, want.z), fp.mul(want.y, got.z))
+    match = np.asarray(eq_x & eq_y)
+    assert match.all(), f"projective mismatch at lanes {np.where(~match)[0]}"
+
+
+def test_full_p256_verifier_parity_with_pallas_flag(monkeypatch):
+    """End-to-end A/B on identical inputs for the P-256 family."""
+    import consensus_tpu.models.ecdsa_p256 as model
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    n = 4
+    msgs, sigs, keys = [], [], []
+    for i in range(n):
+        sk = ec.derive_private_key(i + 12345, ec.SECP256R1())
+        pk = sk.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.UncompressedPoint
+        )
+        m = b"p256-pallas-%d" % i
+        msgs.append(m)
+        sigs.append(
+            model.raw_signature_from_der(sk.sign(m, ec.ECDSA(hashes.SHA256())))
+        )
+        keys.append(pk)
+    sigs[1] = bytes(32) + sigs[1][32:]  # r = 0: invalid
+    expected = [True, False, True, True]
+
+    baseline = list(
+        np.asarray(
+            model.EcdsaP256BatchVerifier(min_device_batch=1).verify_batch(
+                msgs, sigs, keys
+            )
+        )
+    )
+
+    monkeypatch.setenv("CTPU_PALLAS_SCAN", "1")
+    monkeypatch.setenv("CTPU_PALLAS_TILE", "4")
+    fresh = jax.jit(model.verify_impl)
+    monkeypatch.setattr(model, "_verify_kernel", fresh)
+    out = list(
+        np.asarray(
+            model.EcdsaP256BatchVerifier(min_device_batch=1).verify_batch(
+                msgs, sigs, keys
+            )
+        )
+    )
+    assert out == expected
+    assert out == baseline
