@@ -128,13 +128,19 @@ class PersistedState:
 
     # --- saving ------------------------------------------------------------
 
-    def save(self, record: SavedMessage, on_durable=None) -> None:
+    def save(self, record: SavedMessage, on_durable=None,
+             truncate: Optional[bool] = None) -> None:
         """Persist one protocol step; ``on_durable`` fires once the record
         is on stable storage (immediately for per-append fsync, deferred
         under group commit — the protocol defers its sends behind it).
 
         A new ProposedRecord doubles as a truncation point: the previous
-        proposal is then stably decided (reference state.go:38-59)."""
+        proposal is then stably decided (reference state.go:38-59).
+        ``truncate`` overrides that default — the view changer's embedded
+        in-flight endorsement appends a ProposedRecord that implies NO new
+        decision (the sequence is the contested one), and truncating there
+        would erase the pending-view-change vote the crash-restore rejoin
+        depends on."""
         if isinstance(record, ProposedRecord):
             self._in_flight.store_proposal(record.pre_prepare.proposal)
             self._mem_proposed, self._mem_commit = record, None
@@ -144,7 +150,9 @@ class PersistedState:
         self._last_written = record
         self._wal.append(
             encode_saved(record),
-            truncate_to=isinstance(record, ProposedRecord),
+            truncate_to=(
+                isinstance(record, ProposedRecord) if truncate is None else truncate
+            ),
             on_durable=on_durable,
         )
 

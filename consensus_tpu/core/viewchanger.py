@@ -52,6 +52,10 @@ from consensus_tpu.wire import (
     Commit,
     ConsensusMessage,
     NewView,
+    PrePrepare,
+    Prepare,
+    ProposedRecord,
+    SavedCommit,
     SavedNewView,
     SavedViewChange,
     SignedViewData,
@@ -150,7 +154,20 @@ def check_in_flight(
     viewchanger.go:815-909 (CheckInFlight), conditions:
     A2 — some proposal at the expected sequence was seen prepared by ≥ f+1;
     A1 — ≥ quorum don't contradict it (no *different* prepared proposal);
-    B  — ≥ quorum report no prepared in-flight at the expected sequence."""
+    B  — ≥ quorum report no prepared in-flight at the expected sequence.
+
+    One deliberate difference from the reference's A1: an UNPREPARED
+    attestation of a *different* proposal counts as no-argument.  The
+    reference counts it as a contradiction for A while counting the very
+    same entry toward "no prepared in-flight" for B — incoherent, and the
+    A-side reading wedges the cluster forever when mixed-view crash
+    restores leave attestations split (seed-1268 chaos hunt: P@v10
+    prepared on two replicas, later views' unprepared proposals on the
+    other two — every change unsatisfiable).  An unprepared attestation
+    means that replica never commit-signed anything at the sequence, so
+    no decision it participated in is endangered by adopting the prepared
+    candidate; only a prepared certificate can argue (classic PBFT's
+    max-view-prepared rule has the same character)."""
     expected_seq = (
         max(
             (
@@ -163,18 +180,18 @@ def check_in_flight(
         + 1
     )
     no_in_flight_count = 0
-    entries: list[tuple[Optional[Proposal], Optional[ViewMetadata]]] = []
+    entries: list[tuple[Optional[Proposal], Optional[ViewMetadata], bool]] = []
     possible: list[Proposal] = []
     for vd in messages:
         p = vd.in_flight_proposal
         if p is None:
             no_in_flight_count += 1
-            entries.append((None, None))
+            entries.append((None, None, False))
             continue
         if not p.metadata:
             raise ValueError("in-flight proposal without metadata")
         md = decode_view_metadata(p.metadata)
-        entries.append((p, md))
+        entries.append((p, md, vd.in_flight_prepared))
         if md.latest_sequence != expected_seq or not vd.in_flight_prepared:
             no_in_flight_count += 1
             continue
@@ -184,13 +201,18 @@ def check_in_flight(
     for candidate in possible:
         preprepared = 0
         no_argument = 0
-        for p, md in entries:
+        for p, md, prepared in entries:
             if p is None or md is None or md.latest_sequence != expected_seq:
                 no_argument += 1
                 continue
             if p == candidate:
                 no_argument += 1
                 preprepared += 1
+            elif not prepared:
+                # A different-but-UNPREPARED attestation asserts "nothing
+                # prepared here" (condition B already counts it that way);
+                # it carries no commit signature and so cannot argue.
+                no_argument += 1
         if preprepared >= f + 1 and no_argument >= quorum:
             return True, False, candidate  # condition A
 
@@ -202,21 +224,44 @@ def check_in_flight(
 class _NextViews:
     """(view -> voters) bookkeeping for laggard help.
 
-    Parity: reference internal/bft/util.go:145-163 (nextViews)."""
+    Parity: reference internal/bft/util.go:145-163 (nextViews), with one
+    liveness-critical difference and one runtime-model adaptation:
+
+    * The help gate RE-FIRES like the reference's ``sendRecv`` (true
+      whenever the examined vote is the sender's latest) — an earlier
+      once-per-(view, sender) guard wedged a healed cluster forever: the
+      single help broadcast happened while the chaos was still dropping
+      messages, and nothing ever re-fired (seed-1234 targeted-chaos hunt:
+      three replicas collecting for views 19/22/23, no two alike).
+    * Re-fires are rate-limited per (view, sender).  Helps are broadcasts
+      that other eligible helpers may respond to in turn; the reference
+      dampens that amplification with its bounded incoming-message queue
+      (InMsgQSize drops excess), which this event-driven runtime does not
+      have — the time gate is the equivalent backpressure, sized by the
+      caller to the vote-resend cadence so a post-heal wedge still
+      resolves within one resend period."""
 
     def __init__(self) -> None:
         self._votes: dict[int, set[int]] = {}
-        self._helped: set[tuple[int, int]] = set()
+        self._latest: dict[int, int] = {}
+        self._last_help: dict[tuple[int, int], float] = {}
 
     def register(self, view: int, sender: int) -> None:
         self._votes.setdefault(view, set()).add(sender)
+        if view > self._latest.get(sender, -1):
+            self._latest[sender] = view
 
-    def send_recv(self, view: int, sender: int) -> bool:
-        """True the first time we see (view, sender) needing help."""
-        key = (view, sender)
-        if key in self._helped:
+    def send_recv(self, view: int, sender: int, now: float,
+                  min_interval: float) -> bool:
+        """True while ``view`` is the newest vote seen from ``sender`` and
+        this (view, sender) hasn't been helped within ``min_interval``."""
+        if self._latest.get(sender) != view:
             return False
-        self._helped.add(key)
+        key = (view, sender)
+        last = self._last_help.get(key)
+        if last is not None and now - last < min_interval:
+            return False
+        self._last_help[key] = now
         return True
 
     def views_above(self, view: int) -> list[int]:
@@ -228,7 +273,8 @@ class _NextViews:
 
     def clear(self) -> None:
         self._votes.clear()
-        self._helped.clear()
+        self._latest.clear()
+        self._last_help.clear()
 
 
 class ViewChanger:
@@ -298,6 +344,10 @@ class ViewChanger:
         self._in_flight_view: Optional[View] = None
         self._pending_transition = False
         self._pending_join_target: Optional[int] = None
+        #: Distinct senders whose ViewData we rejected as too far
+        #: ahead this collection round — f+1 of them prove WE are the
+        #: behind party (see _check_last_decision).
+        self._far_ahead_senders: set[int] = set()
 
         self._timer: Optional[TimerHandle] = None
         self._stopped = True
@@ -413,6 +463,15 @@ class ViewChanger:
         if self.next_view == self.curr_view + 1:
             self._check_timeout = True  # already changing; keep the clock on
             return
+        # ADVANCING to a new change: a live embedded in-flight view belongs
+        # to the change being left behind and must not keep committing
+        # concurrently with it (the reference's commitInFlightProposal
+        # blocks the whole view changer and `defer Abort()`s the embedded
+        # view on every exit, so it can never coexist with the next change
+        # — viewchanger.go:1187,1287; an embedded view that survived here
+        # delivered a stale decision AFTER the next view re-proposed the
+        # same sequence: the seed-1144/1427 chaos-hunt fork).
+        self._abandon_in_flight_view()
         self.next_view = self.curr_view + 1
         self._update_view_gauges()
         self._requests_timer.stop_timers()
@@ -431,6 +490,9 @@ class ViewChanger:
         Parity: reference viewchanger.go:327-353."""
         if self._stopped or view < self.curr_view:
             return
+        # Same rule as an advancing start_view_change: the embedded view
+        # belongs to the change sync just moved us past.
+        self._abandon_in_flight_view()
         self.curr_view = view
         self.real_view = view
         self.next_view = view
@@ -438,6 +500,7 @@ class ViewChanger:
         self._nvs.clear()
         self._view_change_votes = {}
         self._view_data_votes = {}
+        self._far_ahead_senders.clear()
         self._check_timeout = False
         self._backoff_factor = 1
         self._requests_timer.restart_timers()
@@ -477,7 +540,9 @@ class ViewChanger:
         if (
             self.next_view == self.curr_view + 1
             and self.real_view < vc.next_view < self.curr_view + 1
-            and self._nvs.send_recv(vc.next_view, sender)
+            and self._nvs.send_recv(
+                vc.next_view, sender, self._sched.now(), self._resend_timeout
+            )
         ):
             # Help lagging nodes converge on the earlier view change.
             self._comm.broadcast(ViewChange(next_view=vc.next_view))
@@ -521,6 +586,7 @@ class ViewChanger:
         self._update_view_gauges()
         self._view_change_votes = {}  # all stale: they were for an older view+1
         self._view_data_votes = {}
+        self._far_ahead_senders.clear()
         self.start_view_change(self.curr_view, stop_view=True)
         # Count any already-registered votes for the target view.
         for voter in self._nvs.voters_of(target):
@@ -560,10 +626,17 @@ class ViewChanger:
             if self.curr_view != prior_view or self.next_view != target:
                 return  # superseded while awaiting durability
             self._controller.abort_view(prior_view)
+            # Installing the joined change: an embedded view still running
+            # for the PREVIOUS change must not survive it (its late decide
+            # would install this view without a NewView quorum) — covers
+            # the path where start_view_change's already-changing guard
+            # returned before its own abandon.
+            self._abandon_in_flight_view()
             self.curr_view = target
             self._update_view_gauges()
             self._view_change_votes = {}
             self._view_data_votes = {}
+            self._far_ahead_senders.clear()  # fresh evidence window per round
             svd = self._prepare_view_data()
             leader = self._get_leader()
             if leader == self.self_id:
@@ -687,7 +760,25 @@ class ViewChanger:
         if last_md.view_id >= vd.next_view:
             return False, 0
         if last_md.latest_sequence > my_seq + 1:
-            return False, 0  # too far ahead; might lack config to validate
+            # Too far ahead to validate (might lack the config): reject the
+            # vote, like the reference — ONE such sender might be lying.
+            # But f+1 DISTINCT far-ahead senders contain an honest one, so
+            # WE are provably behind: sync now.  The reference leaves this
+            # to the view-change timeout's sync; that starves when every
+            # vote-driven join resets the timeout clock faster than it can
+            # fire (seed-1144 chaos livelock: the behind leader's ViewData
+            # was rejected each cycle, CheckInFlight stayed unsatisfiable,
+            # and the cluster churned view changes forever).
+            self._far_ahead_senders.add(sender)
+            if len(self._far_ahead_senders) >= self.f + 1:
+                logger.warning(
+                    "%d: %d senders report decisions far ahead of our seq "
+                    "%d — we are behind; syncing",
+                    self.self_id, len(self._far_ahead_senders), my_seq,
+                )
+                self._far_ahead_senders.clear()
+                self._synchronizer.sync()
+            return False, 0
         if last_md.latest_sequence < my_seq:
             return False, 0  # behind us; might lack config to validate
         if last_md.latest_sequence == my_seq:
@@ -710,6 +801,9 @@ class ViewChanger:
             )
             return False, 0
         self._deliver_decision(vd.last_decision, vd.last_decision_signatures)
+        # my_seq just advanced: far-ahead evidence gathered against the old
+        # sequence no longer proves anything — start fresh.
+        self._far_ahead_senders.clear()
         self._committed_during_view_change = last_md
         if self._stopped:  # delivery carried a reconfig
             return False, 0
@@ -746,6 +840,19 @@ class ViewChanger:
 
     def _process_new_view(self, msg: NewView) -> None:
         """Parity: reference viewchanger.go:1111-1168."""
+        if self.next_view == self.curr_view + 1:
+            # A NEWER change is already in progress: this NewView is for
+            # the change we moved past.  Acting on it (worst case starting
+            # an embedded in-flight view whose late decide would install
+            # the newer view without its own NewView quorum) re-opens the
+            # stale-decide hole — the reference cannot reach this state at
+            # all because its view-changer loop blocks while a NewView is
+            # being acted on.
+            logger.info(
+                "%d: ignoring NewView for view %d — already changing to %d",
+                self.self_id, self.curr_view, self.next_view,
+            )
+            return
         while True:
             valid, called_sync, called_deliver = self._validate_new_view(msg)
             if not called_deliver:
@@ -966,16 +1073,53 @@ class ViewChanger:
         view._curr_commit_sent = commit
         self._in_flight_view = view
         self._pending_transition = True
-        view.start()
-        # Peers that started their embedded view later missed our commit
-        # broadcast: re-send it every tick until the view decides (the
-        # reference instead delays its start by two ticks and relies on the
-        # run-loop re-broadcast, viewchanger.go:1277-1280 + view.go:285-288).
-        self._rebroadcast_in_flight_commit(view, commit)
-        logger.info(
-            "%d: started embedded in-flight view %d for seq %d",
-            self.self_id, view.number, view.proposal_sequence,
+        # PERSIST THE ENDORSEMENT BEFORE THE SIGNATURE LEAVES THIS PROCESS
+        # (the normal 3-phase discipline, core/view.py): the commit
+        # signature minted above can complete a 2f+1 quorum at ANY later
+        # time, so from this point every future ViewData of ours must
+        # attest (proposal, prepared) — otherwise a subsequent view change
+        # can conclude "no in-flight", re-propose this sequence fresh, and
+        # fork against whoever assembles the quorum (the second half of
+        # the seed-1144/1427 chaos-hunt fork).  Saving the records also
+        # updates InFlightData (store_proposal + store_prepared) and gives
+        # a crash-restore the standard [proposed, commit] tail to resurrect
+        # the endorsement from.
+        self._state.save(
+            ProposedRecord(
+                pre_prepare=PrePrepare(
+                    view=view.number,
+                    seq=view.proposal_sequence,
+                    proposal=proposal,
+                ),
+                prepare=Prepare(
+                    view=view.number,
+                    seq=view.proposal_sequence,
+                    digest=proposal.digest(),
+                ),
+                verified=True,
+            ),
+            # No truncation: this record implies no newly-decided sequence,
+            # and the default truncate-on-proposal would erase the pending
+            # SavedViewChange/SavedNewView history a crash-restore needs.
+            truncate=False,
         )
+
+        def start_after_durable() -> None:
+            if self._stopped or self._in_flight_view is not view:
+                return  # abandoned while the record was flushing
+            view.start()
+            # Peers that started their embedded view later missed our
+            # commit broadcast: re-send it every tick until the view
+            # decides (the reference instead delays its start by two ticks
+            # and relies on the run-loop re-broadcast,
+            # viewchanger.go:1277-1280 + view.go:285-288).
+            self._rebroadcast_in_flight_commit(view, commit)
+            logger.info(
+                "%d: started embedded in-flight view %d for seq %d",
+                self.self_id, view.number, view.proposal_sequence,
+            )
+
+        self._state.save(SavedCommit(commit=commit), on_durable=start_after_durable)
 
     def _rebroadcast_in_flight_commit(self, view: View, commit: Commit) -> None:
         if self._stopped or self._in_flight_view is not view or view.stopped:

@@ -256,7 +256,16 @@ def _run_targeted_chaos(seed, n, durability_window=0.0):
     cluster.assert_ledgers_consistent()
 
 
-@pytest.mark.parametrize("seed,n", [(1, 4), (2, 7), (3, 4), (5, 7)])
+# Seed 1234 is the diverged-next-views wedge: post-heal, three replicas
+# stuck collecting for views 19/22/23 (no two alike) with the fourth
+# settled — convergence requires the laggard-help broadcast to RE-FIRE
+# on vote resends (reference sendRecv semantics); a once-per-(view,
+# sender) guard wedged it forever (round-5 hunt, 1600+ runs).
+# Seed 1144: the diverged-backoff livelock — a behind replica whose
+# view-change timeout is perpetually reset by vote-driven joins never
+# syncs, its ViewData is rejected each round, and CheckInFlight stays
+# unsatisfiable; fixed by the f+1-far-ahead-senders sync trigger.
+@pytest.mark.parametrize("seed,n", [(1, 4), (2, 7), (3, 4), (5, 7), (1234, 4), (1144, 4), (1427, 4)])
 def test_targeted_message_chaos(seed, n):
     _run_targeted_chaos(seed, n)
 
@@ -270,7 +279,12 @@ def test_targeted_message_chaos_sweep(seed, n):
 #: Message-kind-targeted chaos under group-commit durability (see
 #: test_randomized_fault_soak_group_commit): drop rules x deferred
 #: flushes x crashes that lose unflushed records.
+# Seed 1268: mixed-view crash restores left split in-flight attestations
+# (P@v10 prepared on two replicas, later views' unprepared proposals on
+# the others) — unsatisfiable forever until check_in_flight stopped
+# counting unprepared attestations as condition-A arguments.
 @pytest.mark.parametrize("seed,n", [(1, 4), (2, 7), (400, 4), (401, 7),
-                                    (402, 4), (403, 7), (404, 4), (405, 7)])
+                                    (402, 4), (403, 7), (404, 4), (405, 7),
+                                    (1268, 4)])
 def test_targeted_message_chaos_group_commit(seed, n):
     _run_targeted_chaos(seed, n, durability_window=0.05)

@@ -1112,3 +1112,244 @@ class TestNewViewMalformedMatrix:
         vc.handle_message(3, self._svd(3, data))
         assert vc._view_data_votes.get(3) is None
         vc.stop()
+
+
+def test_decision_already_synced_not_delivered_again_from_view_data():
+    """Deliver-twice guard: a decision already obtained via sync (the
+    checkpoint advanced past it) must NOT be re-delivered when a ViewData
+    carries the same decision — it counts as an equal-sequence vote with
+    zero deliveries.  Parity: reference controller_test.go
+    TestDeliverTwiceOnceFromSyncAndOnceFromViewData:1196 (there the guard
+    is the checkpoint update; same guard here via _extract_current_
+    sequence reading the checkpoint the sync path set)."""
+    from consensus_tpu.wire import SignedViewData, ViewChange as VC, encode_view_data
+
+    vc, sched, comm, controller, timer = _make_vc()
+    vc.start(0)
+    for sender in (1, 3, 4):
+        vc.handle_message(sender, VC(next_view=1))
+    sched.advance(0.1)
+
+    # A sync (not shown) delivered decision seq 1 and set the checkpoint.
+    decision = proposal_at(1)
+    vc._checkpoint.set(decision, [Signature(id=i, value=b"sig-%d" % i) for i in (1, 3, 4)])
+
+    data = ViewData(
+        next_view=1,
+        last_decision=decision,
+        last_decision_signatures=tuple(
+            Signature(id=i, value=b"sig-%d" % i) for i in (1, 3, 4)
+        ),
+    )
+    svd = SignedViewData(
+        signer=3, raw_view_data=encode_view_data(data), signature=b"sig-3"
+    )
+    vc.handle_message(3, svd)
+    assert controller.delivered == [], "already-synced decision re-delivered"
+    assert vc._view_data_votes.get(3) is not None, "equal-seq vote must count"
+    vc.stop()
+
+
+def test_laggard_help_refires_on_vote_resend():
+    """The laggard-help broadcast must fire on EVERY resend of a sender's
+    latest vote (reference util.go sendRecv: `next == nv.n[sender]`), not
+    once per (view, sender) — the first help can be lost to the same
+    fault that diverged the views in the first place.  Regression for the
+    seed-1234 chaos wedge: three replicas collecting for views 19/22/23
+    (no two alike) never converge if help cannot re-fire after a heal."""
+    from consensus_tpu.wire import ViewChange as VC
+
+    vc, sched, comm, controller, timer = _make_vc()
+    vc.start(0)
+    # Shape the changer like a post-chaos survivor: installed view 1,
+    # then advanced to curr 2 and started collecting for 3.
+    vc.curr_view = 2
+    vc.real_view = 1
+    vc.next_view = 3
+
+    def helps():
+        return [
+            m for m in comm.broadcasts
+            if isinstance(m, VC) and m.next_view == 2
+        ]
+
+    vc.handle_message(3, VC(next_view=2))  # laggard vote: real < 2 < curr+1
+    assert len(helps()) == 1, "first laggard vote must trigger help"
+    # An IMMEDIATE duplicate is rate-limited (helps are broadcasts other
+    # helpers react to; unthrottled re-fires amplify exponentially).
+    vc.handle_message(3, VC(next_view=2))
+    assert len(helps()) == 1, "immediate duplicate must be throttled"
+    # The laggard's periodic resend (a resend-interval later) re-fires.
+    sched.advance(vc._resend_timeout + 0.1)
+    vc.handle_message(3, VC(next_view=2))
+    assert len(helps()) == 2, "help must re-fire on the periodic resend"
+    sched.advance(vc._resend_timeout + 0.1)
+
+    # A newer vote from the same sender retires the old one: resending the
+    # stale view no longer triggers help.
+    vc.handle_message(3, VC(next_view=3))
+    before = len(helps())
+    vc.handle_message(3, VC(next_view=2))
+    assert len(helps()) == before, "stale (non-latest) votes must not help"
+    vc.stop()
+
+
+class TestEmbeddedInFlightViewSafety:
+    """The two halves of the seed-1144/1427 chaos-hunt FORK (round 5):
+    an embedded in-flight commit view that (a) survived into the next view
+    change and delivered a stale decision after that view re-proposed the
+    same sequence, and (b) minted a commit signature with no persisted
+    endorsement, so later ViewData stopped attesting the prepared proposal
+    and CheckInFlight concluded "no in-flight"."""
+
+    def _vc_with_embedded(self):
+        from consensus_tpu.wire import ViewChange as VC
+
+        vc, sched, comm, controller, timer = _make_vc()
+        vc.start(0)
+        for sender in (1, 3, 4):
+            vc.handle_message(sender, VC(next_view=1))
+        sched.advance(0.1)
+        proposal = proposal_at(1, view=0, payload=b"in-flight")
+        vc._commit_in_flight(proposal)
+        assert vc._in_flight_view is not None, "embedded view must start"
+        return vc, sched, comm, controller, proposal
+
+    def test_embedded_commit_is_persisted_before_broadcast(self):
+        """Signing the embedded commit is an ENDORSEMENT: the standard
+        [proposed, commit] WAL tail must exist before the signature can
+        leave the process, and InFlightData must mark it prepared."""
+        from consensus_tpu.wire import ProposedRecord, SavedCommit
+
+        vc, sched, comm, controller, proposal = self._vc_with_embedded()
+        # PersistedState wraps the MemWAL; decode the WAL's entries.
+        from consensus_tpu.wire import decode_saved
+
+        records = [decode_saved(e) for e in vc._state._wal.entries]
+        assert any(
+            isinstance(r, ProposedRecord) and r.pre_prepare.proposal == proposal
+            for r in records
+        ), "embedded endorsement missing its ProposedRecord"
+        assert any(
+            isinstance(r, SavedCommit)
+            and r.commit.digest == proposal.digest()
+            for r in records
+        ), "embedded endorsement missing its SavedCommit"
+        assert vc._in_flight.proposal() == proposal
+        assert vc._in_flight.is_prepared()
+        vc.stop()
+
+    def test_view_data_attests_embedded_endorsement(self):
+        """After starting the embedded commit, every ViewData this replica
+        produces must attest (proposal, prepared=True) — a later view
+        change must adopt the proposal, not re-propose the sequence."""
+        from consensus_tpu.wire import decode_view_data
+
+        vc, sched, comm, controller, proposal = self._vc_with_embedded()
+        svd = vc._prepare_view_data()
+        vd_out = decode_view_data(svd.raw_view_data)
+        assert vd_out.in_flight_proposal == proposal
+        assert vd_out.in_flight_prepared is True
+        vc.stop()
+
+    def test_advancing_view_change_aborts_embedded_view(self):
+        """Joining the NEXT view change must abort a live embedded view —
+        the reference's blocking commitInFlightProposal defer-aborts it on
+        every exit path; event-driven concurrency must not let it deliver
+        a stale decision after the next view re-proposes the sequence."""
+        from consensus_tpu.wire import ViewChange as VC
+
+        vc, sched, comm, controller, proposal = self._vc_with_embedded()
+        embedded = vc._in_flight_view
+        for sender in (1, 3, 4):
+            vc.handle_message(sender, VC(next_view=2))
+        sched.advance(0.1)
+        assert vc._in_flight_view is None, "embedded view survived the advance"
+        assert embedded.stopped, "embedded view not aborted"
+        vc.stop()
+
+    def test_inform_new_view_aborts_embedded_view(self):
+        vc, sched, comm, controller, proposal = self._vc_with_embedded()
+        embedded = vc._in_flight_view
+        vc.inform_new_view(5)
+        assert vc._in_flight_view is None
+        assert embedded.stopped
+        vc.stop()
+
+
+class TestCheckInFlightUnpreparedArguments:
+    """Round-5 rule (seed-1268 chaos livelock): an UNPREPARED attestation
+    of a different proposal at the expected sequence counts as NO-ARGUMENT
+    for condition A — it already counts as "no prepared in-flight" for
+    condition B, and it carries no commit signature, so it cannot endanger
+    the prepared candidate."""
+
+    def _p(self, view, payload):
+        return proposal_at(2, view=view, payload=payload)
+
+    def test_split_mixed_view_attestations_resolve_to_prepared(self):
+        """The exact seed-1268 shape: two replicas prepared P@v10, the
+        other two hold later views' unprepared proposals at the same
+        sequence — the prepared proposal must be adopted."""
+        p10 = self._p(10, b"p10")
+        msgs = [
+            vd(last_seq=1, in_flight=self._p(16, b"p16")),          # unprepared
+            vd(last_seq=1, in_flight=self._p(13, b"p13")),          # unprepared
+            vd(last_seq=1, in_flight=p10, prepared=True),
+            vd(last_seq=1, in_flight=p10, prepared=True),
+        ]
+        ok, no, prop = check_in_flight(msgs, F, QUORUM)
+        assert (ok, no, prop) == (True, False, p10)
+
+    def test_two_prepared_still_argue(self):
+        """PREPARED attestations of different proposals still contradict:
+        either might hide a commit quorum, so the change must wait."""
+        a = self._p(10, b"a")
+        b = self._p(12, b"b")
+        msgs = [
+            vd(last_seq=1, in_flight=a, prepared=True),
+            vd(last_seq=1, in_flight=a, prepared=True),
+            vd(last_seq=1, in_flight=b, prepared=True),
+            vd(last_seq=1, in_flight=b, prepared=True),
+        ]
+        ok, no, prop = check_in_flight(msgs, F, QUORUM)
+        assert (ok, no, prop) == (False, False, None)
+
+
+def test_f_plus_one_far_ahead_senders_trigger_sync():
+    """Round-5 rule (seed-1144 chaos livelock): ONE far-ahead ViewData
+    sender might be lying (reject, like the reference), but f+1 DISTINCT
+    far-ahead senders contain an honest one — the collecting leader is
+    provably behind and must sync instead of waiting for a view-change
+    timeout that vote-driven joins keep resetting."""
+    from consensus_tpu.wire import SignedViewData, ViewChange as VC, encode_view_data
+
+    vc, sched, comm, controller, timer = _make_vc()
+    vc.start(0)
+    for sender in (1, 3, 4):
+        vc.handle_message(sender, VC(next_view=1))
+    sched.advance(0.1)
+
+    def far_ahead_svd(sender):
+        data = ViewData(
+            next_view=1,
+            last_decision=proposal_at(5),  # 5 >> our 0 + 1
+            last_decision_signatures=tuple(
+                Signature(id=i, value=b"sig-%d" % i) for i in (1, 3, 4)
+            ),
+        )
+        return SignedViewData(
+            signer=sender,
+            raw_view_data=encode_view_data(data),
+            signature=b"sig-%d" % sender,
+        )
+
+    before = controller.synced
+    vc.handle_message(3, far_ahead_svd(3))
+    assert controller.synced == before, "one sender must not trigger sync"
+    assert vc._view_data_votes.get(3) is None  # still rejected
+    vc.handle_message(3, far_ahead_svd(3))  # duplicate sender: still one
+    assert controller.synced == before
+    vc.handle_message(4, far_ahead_svd(4))  # f+1 distinct senders
+    assert controller.synced == before + 1, "f+1 far-ahead senders must sync"
+    vc.stop()
