@@ -69,6 +69,10 @@ from consensus_tpu.wire import (
 
 logger = logging.getLogger("consensus_tpu.viewchanger")
 
+#: Ceiling for the view-change timeout backoff factor: rounds lengthen
+#: T, 2T, ... up to this multiple and stay there.
+_BACKOFF_CAP = 8
+
 
 class ControllerPort(Protocol):
     """What the view changer needs from the controller."""
@@ -414,12 +418,32 @@ class ViewChanger:
             self.self_id, self.next_view, self._backoff_factor,
         )
         self._check_timeout = False
-        self._backoff_factor += 1
+        # Grow the round length (anti-thrash) but CAP it: an uncapped
+        # factor accumulated during a long fault storm turns into a
+        # minutes-long recovery stall after the network heals (a healed
+        # cluster should converge within a few bounded rounds).
+        self._backoff_factor = min(self._backoff_factor + 1, _BACKOFF_CAP)
+        # Start each round from a FRESH view of peers' votes: corrupt or
+        # stale next-view registrations otherwise poison the laggard-help
+        # gate forever (a phantom high "latest vote" recorded during a
+        # fault storm makes send_recv reject the sender's genuine resends
+        # for eternity — the seed-171 corruption-chaos wedge).  Genuine
+        # votes re-register within one resend interval.
+        self._nvs.clear()
         if self._in_flight_view is not None:
             # The embedded in-flight view failed to commit in time.
             self._abandon_in_flight_view()
         self._synchronizer.sync()
         self.start_view_change(self.curr_view, stop_view=False)
+        # The new timeout ROUND starts now: start_view_change's
+        # already-changing early path re-arms the flag but keeps the old
+        # _start_change_time, so without this reset every subsequent tick
+        # "times out" again instantly and the backoff factor runs away
+        # (observed at 150+ during a long corruption storm — a 1,500 s
+        # recovery delay after the network healed).  The reference has the
+        # same latent runaway (viewchanger.go:370 re-arms without touching
+        # startViewChangeTime).
+        self._start_change_time = self._sched.now()
         return True
 
     # ------------------------------------------------------------ identity

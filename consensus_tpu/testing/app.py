@@ -8,6 +8,7 @@ Consensus over the same WAL content.
 
 from __future__ import annotations
 
+import hashlib
 import struct
 from typing import Optional, Sequence
 
@@ -70,6 +71,11 @@ class ByteInspector(RequestInspector):
         if not client or not rid:
             raise ValueError(f"malformed request {raw_request!r}")
         return RequestInfo(client_id=client, request_id=rid)
+
+
+def _toy_digest(data: bytes) -> bytes:
+    """Short content digest for the toy signature scheme."""
+    return hashlib.sha256(data).hexdigest()[:12].encode()
 
 
 class MemWAL(WriteAheadLog):
@@ -174,11 +180,25 @@ class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
         )
 
     # Signer
+    # Toy signatures BIND THE SIGNED CONTENT (id + a digest of the bytes):
+    # content-free values (the old b"sig-<id>") let a byzantine network
+    # tamper a carried last-decision payload undetectably — the round-5
+    # mutation chaos forked the ledger through exactly that hole, which
+    # real Ed25519 consenter signatures (models/verifier.py) never allow.
     def sign(self, data: bytes) -> bytes:
-        return b"sig-%d" % self.node_id
+        return b"sig-%d:%s" % (self.node_id, _toy_digest(data))
 
     def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature:
-        return Signature(id=self.node_id, value=b"sig-%d" % self.node_id, msg=aux)
+        # Binds BOTH the proposal content and the aux payload (the
+        # PreparesFrom proof travels in Signature.msg), mirroring what the
+        # real Ed25519 signer signs (models/verifier.py commit_message).
+        return Signature(
+            id=self.node_id,
+            value=b"sig-%d:%s" % (
+                self.node_id, _toy_digest(proposal.digest().encode() + aux)
+            ),
+            msg=aux,
+        )
 
     # Verifier
     def verify_proposal(self, proposal: Proposal) -> Sequence[RequestInfo]:
@@ -188,12 +208,17 @@ class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
         return self.inspector.request_id(raw_request)
 
     def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
-        if signature.value != b"sig-%d" % signature.id:
+        expect = b"sig-%d:%s" % (
+            signature.id,
+            _toy_digest(proposal.digest().encode() + signature.msg),
+        )
+        if signature.value != expect:
             raise ValueError(f"bad signature from {signature.id}")
         return signature.msg
 
     def verify_signature(self, signature: Signature) -> None:
-        if signature.value != b"sig-%d" % signature.id:
+        expect = b"sig-%d:%s" % (signature.id, _toy_digest(signature.msg))
+        if signature.value != expect:
             raise ValueError(f"bad signature from {signature.id}")
 
     def verification_sequence(self) -> int:

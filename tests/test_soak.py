@@ -288,3 +288,212 @@ def test_targeted_message_chaos_sweep(seed, n):
                                     (1268, 4)])
 def test_targeted_message_chaos_group_commit(seed, n):
     _run_targeted_chaos(seed, n, durability_window=0.05)
+
+
+def _run_byzantine_mutation_chaos(seed, n, durability_window=0.0):
+    """Message-CORRUPTION chaos (round 5): a byzantine network rewrites
+    random fields of in-flight messages — wrong views/seqs/digests, cross-
+    signer signature swaps, forged signature bytes, garbled SignedViewData,
+    truncated/duplicated NewView sets, lying heartbeats and state-transfer
+    claims — at rates up to total corruption of a message kind, mixed with
+    crashes and partitions.  Validation must shed ALL of it: an unhandled
+    exception in any replica, a ledger fork, or a double delivery is a bug.
+    Progress is asserted only after the corruption stops (corrupting many
+    senders' messages at once exceeds the f-byzantine-replica model, so
+    only safety — never liveness — is required while it runs)."""
+    import dataclasses
+
+    from consensus_tpu.wire import (
+        Commit,
+        HeartBeat,
+        HeartBeatResponse,
+        NewView,
+        PrePrepare,
+        Prepare,
+        SignedViewData,
+        StateTransferResponse,
+        ViewChange,
+    )
+
+    rng = random.Random(seed)
+
+    def garble_bytes(b):
+        if not b:
+            return b"\xff"
+        i = rng.randrange(len(b))
+        return b[:i] + bytes([b[i] ^ 0xFF]) + b[i + 1:]
+
+    def corrupt(msg):
+        roll = rng.random()
+        if isinstance(msg, Prepare):
+            if roll < 0.4:
+                return dataclasses.replace(msg, digest="corrupt-" + msg.digest[:8])
+            if roll < 0.7:
+                return dataclasses.replace(msg, view=msg.view + rng.choice([1, 2, 3]))
+            return dataclasses.replace(msg, seq=msg.seq + rng.choice([-1, 1, 5]))
+        if isinstance(msg, Commit):
+            if roll < 0.3:
+                return dataclasses.replace(msg, digest="corrupt-" + msg.digest[:8])
+            if roll < 0.5:
+                # Claim a different signer WITHOUT its key: the signature
+                # bytes stay the original signer's, so verification against
+                # the claimed id must fail.  (Minting another replica's
+                # VALID signature — trivial under this harness's toy crypto
+                # — would model n byzantine replicas, beyond the f-replica
+                # threat model: real adversaries cannot forge signatures.)
+                other = rng.randrange(1, n + 1)
+                return dataclasses.replace(
+                    msg, signature=dataclasses.replace(msg.signature, id=other)
+                )
+            if roll < 0.7:
+                return dataclasses.replace(
+                    msg,
+                    signature=dataclasses.replace(
+                        msg.signature, value=b"forged-bytes"
+                    ),
+                )
+            return dataclasses.replace(msg, view=msg.view + rng.choice([1, 2]))
+        if isinstance(msg, PrePrepare):
+            if roll < 0.4:
+                return dataclasses.replace(
+                    msg,
+                    proposal=dataclasses.replace(
+                        msg.proposal, payload=msg.proposal.payload + b"EVIL"
+                    ),
+                )
+            if roll < 0.7:
+                return dataclasses.replace(
+                    msg,
+                    proposal=dataclasses.replace(
+                        msg.proposal, metadata=garble_bytes(msg.proposal.metadata)
+                    ),
+                )
+            return dataclasses.replace(msg, view=msg.view + rng.choice([1, 3]))
+        if isinstance(msg, ViewChange):
+            return dataclasses.replace(
+                msg, next_view=max(0, msg.next_view + rng.choice([-2, -1, 1, 2, 3]))
+            )
+        if isinstance(msg, SignedViewData):
+            if roll < 0.4:
+                return dataclasses.replace(
+                    msg, raw_view_data=garble_bytes(msg.raw_view_data)
+                )
+            if roll < 0.7:
+                return dataclasses.replace(msg, signer=rng.randrange(1, n + 1))
+            return dataclasses.replace(msg, signature=b"forged")
+        if isinstance(msg, NewView):
+            svds = list(msg.signed_view_data)
+            if not svds:
+                return msg
+            if roll < 0.4 and len(svds) > 1:
+                svds.pop(rng.randrange(len(svds)))  # truncate the quorum
+            elif roll < 0.7:
+                svds.append(rng.choice(svds))       # duplicate an entry
+            else:
+                i = rng.randrange(len(svds))
+                svds[i] = dataclasses.replace(
+                    svds[i], raw_view_data=garble_bytes(svds[i].raw_view_data)
+                )
+            return dataclasses.replace(msg, signed_view_data=tuple(svds))
+        if isinstance(msg, HeartBeat):
+            return dataclasses.replace(
+                msg, view=msg.view + rng.choice([-1, 1, 4]),
+                seq=max(0, msg.seq + rng.choice([-1, 1, 7])),
+            )
+        if isinstance(msg, HeartBeatResponse):
+            return dataclasses.replace(msg, view=msg.view + rng.choice([1, 5]))
+        if isinstance(msg, StateTransferResponse):
+            return dataclasses.replace(
+                msg,
+                view_num=max(0, msg.view_num + rng.choice([-1, 1, 3])),
+                sequence=max(0, msg.sequence + rng.choice([-1, 1, 2])),
+            )
+        return msg
+
+    kinds = [Prepare, Commit, PrePrepare, HeartBeat, HeartBeatResponse,
+             NewView, ViewChange, SignedViewData, StateTransferResponse]
+    cluster = Cluster(
+        n, seed=seed ^ 0xC0FF, config_tweaks=FAST,
+        durability_window=durability_window,
+    )
+    cluster.start()
+    submitted = 0
+    crashed: set[int] = set()
+    corrupt_rules: dict = {}
+
+    def submit_some(k):
+        nonlocal submitted
+        for _ in range(k):
+            cluster.submit_to_all(make_request("byz", submitted))
+            submitted += 1
+
+    def mutate(sender, target, msg):
+        p = corrupt_rules.get(type(msg))
+        if p and rng.random() < p:
+            return corrupt(msg)
+        return msg
+
+    cluster.network.mutate_send = mutate
+    submit_some(4)
+    assert cluster.run_until_ledger(1, max_time=300.0)
+    f = (n - 1) // 3
+    for _ in range(30):
+        roll = rng.random()
+        if roll < 0.15 and len(crashed) < f:
+            victim = rng.choice([i for i in cluster.nodes if i not in crashed])
+            cluster.nodes[victim].crash()
+            crashed.add(victim)
+        elif roll < 0.3 and crashed:
+            cluster.nodes[crashed.pop()].restart()
+        elif roll < 0.6:
+            corrupt_rules[rng.choice(kinds)] = rng.choice([0.3, 0.7, 1.0])
+        elif roll < 0.75:
+            corrupt_rules.clear()
+        elif roll < 0.85 and not crashed:
+            cluster.network.partition([rng.choice(list(cluster.nodes))])
+        else:
+            cluster.network.heal()
+        submit_some(rng.randrange(1, 4))
+        cluster.scheduler.advance(rng.uniform(5.0, 40.0))
+        # SAFETY under arbitrary corruption: no fork, no double delivery.
+        cluster.assert_ledgers_consistent()
+        for node in cluster.nodes.values():
+            digests = [d.proposal.digest() for d in node.app.ledger]
+            assert len(digests) == len(set(digests)), (
+                f"replica {node.node_id} delivered a proposal twice"
+            )
+
+    corrupt_rules.clear()
+    cluster.network.heal()
+    cluster.network.mutate_send = None
+    for nid in list(crashed):
+        cluster.nodes[nid].restart()
+    cluster.scheduler.advance(60.0)
+    floor = max(len(nd.app.ledger) for nd in cluster.nodes.values())
+    submit_some(5)
+    assert cluster.scheduler.run_until(
+        lambda: sum(
+            1 for nd in cluster.nodes.values()
+            if len(nd.app.ledger) >= floor + 1
+        ) >= n - f,
+        max_time=1200.0,
+    ), "cluster failed to progress after corruption stopped"
+    cluster.assert_ledgers_consistent()
+
+
+# Seed 216: a long corruption storm accumulated an uncapped timeout
+# backoff (150+ = a 1,500 s recovery stall after heal) via the stale
+# _start_change_time re-arm runaway; fixed by restarting the timeout
+# round at each firing and capping the factor.
+@pytest.mark.parametrize("seed,n", [(11, 4), (12, 7), (13, 4), (14, 4), (15, 7), (216, 4)])
+def test_byzantine_mutation_chaos(seed, n):
+    _run_byzantine_mutation_chaos(seed, n)
+
+
+# Seeds 171/306/396: corrupt next-view votes registered during the storm
+# permanently poisoned the laggard-help "latest vote" gate (a phantom
+# high registration outranks every genuine resend forever); fixed by
+# clearing the next-view bookkeeping at each timeout round.
+@pytest.mark.parametrize("seed,n", [(171, 4), (306, 4), (396, 4)])
+def test_byzantine_mutation_chaos_group_commit(seed, n):
+    _run_byzantine_mutation_chaos(seed, n, durability_window=0.05)

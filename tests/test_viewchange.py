@@ -815,14 +815,17 @@ class TestViewChangeTimeoutBackoff:
         assert vc.next_view == 1
         assert len(comm.broadcasts) > start_broadcasts
 
-        # The next deadline is start + timeout * backoff measured from the
-        # ORIGINAL start (the already-changing branch deliberately keeps the
-        # clock — reference viewchanger.go:370-372), so deadlines land at
-        # t0+T, t0+2T, ...: half the doubled window must NOT fire it...
-        sched.advance(vc._vc_timeout * 0.4)
+        # Each timeout ROUND restarts its clock (round 5): the next
+        # deadline is (time of last timeout) + 2T, so rounds genuinely
+        # lengthen T, 2T, 3T...  (Measuring from the ORIGINAL start — the
+        # reference's viewchanger.go:370-372 shape — makes deadlines land
+        # at t0+T, t0+2T, ... = a CONSTANT cadence where the multiplier
+        # does nothing except run away during long storms; observed at
+        # backoff 150+ = a 1,500 s post-heal recovery stall.)
+        sched.advance(vc._vc_timeout * 1.0)
         assert controller.synced == 1, "backoff window fired too early"
-        # ...but reaching t0 + 2T does.
-        sched.advance(vc._vc_timeout * 0.7)
+        # ...but 2T past the previous timeout does fire.
+        sched.advance(vc._vc_timeout * 1.2)
         assert controller.synced == 2
         assert vc._backoff_factor == 3
         vc.stop()
