@@ -165,6 +165,17 @@ class Consensus:
             if nv_seq >= seq:
                 logger.info("restoring from new-view record (view %d, seq %d)", nv_view, nv_seq)
                 new_view, new_seq, new_dec = nv_view, nv_seq, 0
+
+        # A tail in-flight proposal from a HIGHER view proves that view was
+        # installed here pre-crash even though its SavedNewView record was
+        # truncated away by the proposal append itself — boot there, not in
+        # the checkpoint's stale view (extension beyond reference
+        # consensus.go:464-504, which has the same blind spot).
+        tail = self.state.load_in_flight_view_if_applicable()
+        if tail is not None and tail[0] > new_view:
+            logger.info("restoring view %d from the in-flight WAL tail", tail[0])
+            new_view = tail[0]
+            new_dec = tail[1]
         return new_view, new_seq, new_dec
 
     def _create_components(self) -> None:

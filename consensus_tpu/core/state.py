@@ -173,6 +173,31 @@ class PersistedState:
             return md.view_id, md.latest_sequence
         return None
 
+    def load_in_flight_view_if_applicable(self) -> Optional[tuple[int, int]]:
+        """(view, decisions_in_view) of the WAL-tail in-flight pre-prepare,
+        if the log ends in one (directly, or behind our commit).
+
+        A proposal record at view v proves v was INSTALLED here before the
+        crash (followers accept and leaders create proposals only inside an
+        active view) — but the SavedNewView record that said so may be gone:
+        the proposal append itself truncates the log.  Booting from the
+        checkpoint's (older) view in that state strands the replica in a
+        view the cluster left long ago, with its view changer blind to the
+        regression (seed-3428 chaos wedge: two restored replicas idling at
+        view 1 while holding (view 8) proposal records).
+
+        Reads the mem-tail ``__init__`` already seeded (same two tail
+        cases, and behind its torn-tail exception guard — a corrupt tail
+        must not fail boot)."""
+        rec = self._mem_proposed
+        if rec is None:
+            return None
+        pp = rec.pre_prepare
+        dec = 0
+        if pp.proposal.metadata:
+            dec = decode_view_metadata(pp.proposal.metadata).decisions_in_view
+        return pp.view, dec
+
     def load_view_change_if_applicable(self) -> Optional[ViewChange]:
         """The pending view-change vote if the log ends with one.
 

@@ -215,13 +215,28 @@ def check_in_flight(
             elif not prepared:
                 # A different-but-UNPREPARED attestation asserts "nothing
                 # prepared here" (condition B already counts it that way);
-                # it carries no commit signature and so cannot argue.
+                # it carries no commit signature and so cannot argue.  This
+                # relaxation only ever helps ADOPT an f+1-corroborated
+                # candidate — the safe direction — and can never flip
+                # condition B, so a lone byzantine claim gains nothing.
                 no_argument += 1
         if preprepared >= f + 1 and no_argument >= quorum:
             return True, False, candidate  # condition A
 
     if no_in_flight_count >= quorum:
         return True, True, None  # condition B
+
+    # KNOWN UNRESOLVABLE SPLIT (kept deliberately, matching the reference):
+    # sub-f+1 prepared attestations of different proposals (e.g. P@v10 on
+    # one replica, P'@v82 on another, rest silent) satisfy neither A nor B
+    # and stall every change until sync or new evidence.  A "supersession"
+    # rule discarding the lower-view attestation is TEMPTING and sound
+    # crash-only, but unsound with f byzantine: attestations are unproven
+    # claims, so a commit-quorum member can deny its signature and
+    # fabricate a higher-view claim, flipping a committed sequence into a
+    # fresh proposal — a fork.  Without carried prepare CERTIFICATES
+    # (which this protocol family, like the reference, does not ship in
+    # ViewData) the stall is the safe outcome.
     return False, False, None
 
 

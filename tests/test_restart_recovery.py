@@ -374,3 +374,51 @@ def test_restore_time_reverify_upgrades_wal_for_second_crash():
     state2.restore(v2)
     assert v2.reverify_calls == []
     assert v2.phase == Phase.PROPOSED
+
+
+def test_boot_view_honors_in_flight_wal_tail():
+    """A tail pre-prepare from view 8 proves view 8 was installed before
+    the crash even when the SavedNewView record was truncated away by the
+    proposal append itself — boot must start there, not in the
+    checkpoint's stale view (seed-3428 chaos wedge: restored replicas
+    idled in view 1 holding view-8 proposal records)."""
+    from consensus_tpu.core.state import InFlightData, PersistedState
+    from consensus_tpu.testing.app import MemWAL
+    from consensus_tpu.types import Proposal
+    from consensus_tpu.wire import (
+        PrePrepare,
+        Prepare,
+        ProposedRecord,
+        SavedCommit,
+        Commit,
+        ViewMetadata,
+        encode_saved,
+        encode_view_metadata,
+    )
+    from consensus_tpu.types import Signature
+
+    md = ViewMetadata(view_id=8, latest_sequence=5, decisions_in_view=2)
+    proposal = Proposal(payload=b"p", metadata=encode_view_metadata(md))
+    rec = ProposedRecord(
+        pre_prepare=PrePrepare(view=8, seq=5, proposal=proposal),
+        prepare=Prepare(view=8, seq=5, digest=proposal.digest()),
+    )
+    entries = [encode_saved(rec)]
+    state = PersistedState(MemWAL(list(entries)), InFlightData(), entries=entries)
+    assert state.load_in_flight_view_if_applicable() == (8, 2)
+
+    # Behind our own commit too.
+    commit = SavedCommit(commit=Commit(
+        view=8, seq=5, digest=proposal.digest(),
+        signature=Signature(id=1, value=b"v"),
+    ))
+    entries2 = [encode_saved(rec), encode_saved(commit)]
+    state2 = PersistedState(MemWAL(list(entries2)), InFlightData(), entries=entries2)
+    assert state2.load_in_flight_view_if_applicable() == (8, 2)
+
+    # Not when something else ends the log.
+    from consensus_tpu.wire import SavedViewChange, ViewChange
+
+    entries3 = entries2 + [encode_saved(SavedViewChange(view_change=ViewChange(next_view=9)))]
+    state3 = PersistedState(MemWAL(list(entries3)), InFlightData(), entries=entries3)
+    assert state3.load_in_flight_view_if_applicable() is None

@@ -167,11 +167,17 @@ def test_randomized_fault_soak_n7_two_faults():
     cluster.assert_ledgers_consistent()
 
 
-def _run_targeted_chaos(seed, n, durability_window=0.0):
+def _run_targeted_chaos(seed, n, durability_window=0.0,
+                        leader_rotation=False):
     """Message-type-targeted chaos: random drop rules per wire kind (up to
     total loss of e.g. every NewView or every Commit), plus crashes and
     partitions — a sharper fault model than uniform loss, and the one that
-    exposed the assist-flagged recovery-rebroadcast bug."""
+    exposed the assist-flagged recovery-rebroadcast bug.
+
+    ``leader_rotation=True`` runs the same storms with rotation on
+    (decisions_per_leader=2): the rotation/blacklist machinery —
+    prev-commit-signature carries, blacklist computation and follower
+    validation, per-leader decision counting — under identical faults."""
     from consensus_tpu.wire import (
         Commit,
         HeartBeat,
@@ -186,8 +192,10 @@ def _run_targeted_chaos(seed, n, durability_window=0.0):
     kinds = [Prepare, Commit, PrePrepare, HeartBeat, NewView, ViewChange,
              StateTransferRequest, StateTransferResponse]
     rng = random.Random(seed)
+    tweaks = dict(FAST, decisions_per_leader=2) if leader_rotation else FAST
     cluster = Cluster(
-        n, seed=seed ^ 0x5A5A, config_tweaks=FAST,
+        n, seed=seed ^ (0x707A if leader_rotation else 0x5A5A),
+        config_tweaks=tweaks, leader_rotation=leader_rotation,
         durability_window=durability_window,
     )
     cluster.start()
@@ -283,9 +291,13 @@ def test_targeted_message_chaos_sweep(seed, n):
 # (P@v10 prepared on two replicas, later views' unprepared proposals on
 # the others) — unsatisfiable forever until check_in_flight stopped
 # counting unprepared attestations as condition-A arguments.
+# Seed 3428: a crash restored two replicas into a view whose SavedNewView
+# record had been truncated away by the proposal append — they idled in
+# view 1 holding (view 8) proposal records; fixed by booting from the
+# in-flight WAL tail's view.
 @pytest.mark.parametrize("seed,n", [(1, 4), (2, 7), (400, 4), (401, 7),
                                     (402, 4), (403, 7), (404, 4), (405, 7),
-                                    (1268, 4)])
+                                    (1268, 4), (3428, 4)])
 def test_targeted_message_chaos_group_commit(seed, n):
     _run_targeted_chaos(seed, n, durability_window=0.05)
 
@@ -497,3 +509,31 @@ def test_byzantine_mutation_chaos(seed, n):
 @pytest.mark.parametrize("seed,n", [(171, 4), (306, 4), (396, 4)])
 def test_byzantine_mutation_chaos_group_commit(seed, n):
     _run_byzantine_mutation_chaos(seed, n, durability_window=0.05)
+
+
+def test_byzantine_mutation_chaos_known_split_boundary():
+    """Seed 1109 manufactures the KNOWN-unresolvable sub-f+1 prepared
+    split (check_in_flight docstring): two replicas attest different
+    old-view prepared proposals, the rest nothing — neither condition A
+    nor B is reachable, and resolving it by supersession would be
+    byzantine-unsound.  The pinned expectation is therefore SAFETY
+    (which _run_byzantine_mutation_chaos asserts throughout): if the
+    run fails, it must fail ONLY the final progress assertion."""
+    try:
+        _run_byzantine_mutation_chaos(1109, 4, durability_window=0.05)
+    except AssertionError as e:
+        assert "progress" in str(e), f"safety violated: {e}"
+
+
+def _run_rotation_chaos(seed, n, durability_window=0.0):
+    """Targeted chaos with LEADER ROTATION on — one loop, full safety
+    checks (a rotation-specific double-delivery would otherwise slip past
+    a diverged copy)."""
+    _run_targeted_chaos(
+        seed, n, durability_window=durability_window, leader_rotation=True
+    )
+
+
+@pytest.mark.parametrize("seed,n", [(21, 4), (22, 7), (23, 4), (24, 7)])
+def test_rotation_chaos(seed, n):
+    _run_rotation_chaos(seed, n)

@@ -566,6 +566,12 @@ class TestCheckInFlightReferenceTable:
         assert (ok, no, prop) == (True, True, None)
 
     def test_three_way_split_not_enough_for_anything(self):
+        """Sub-f+1 prepared splits stall the change — DELIBERATELY.  A
+        supersession rule discarding the lower-view attestation is sound
+        crash-only but unsound with f byzantine (a commit-quorum member
+        can deny its signature and fabricate a higher-view claim, forking
+        a committed sequence); without carried prepare certificates the
+        stall is the safe outcome, as in the reference."""
         exp = self._expected()
         other_view = proposal_at(2, view=1, payload=b"expected")
         other_vseq = Proposal(
@@ -578,6 +584,20 @@ class TestCheckInFlightReferenceTable:
             vd(last_seq=1, in_flight=other_vseq, prepared=True),
             vd(last_seq=1, in_flight=exp, prepared=True),
             vd(last_seq=1, in_flight=other_view, prepared=True),
+        ]
+        ok, no, prop = self.run_case(msgs)
+        assert (ok, no, prop) == (False, False, None)
+
+    def test_same_view_split_still_unresolvable(self):
+        """All-same-view single-witness splits likewise keep waiting."""
+        exp = self._expected()
+        a = proposal_at(2, payload=b"a")
+        b = proposal_at(2, payload=b"b")
+        msgs = [
+            vd(last_seq=1),
+            vd(last_seq=1, in_flight=a, prepared=True),
+            vd(last_seq=1, in_flight=exp, prepared=True),
+            vd(last_seq=1, in_flight=b, prepared=True),
         ]
         ok, no, prop = self.run_case(msgs)
         assert (ok, no, prop) == (False, False, None)
