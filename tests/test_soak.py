@@ -167,6 +167,60 @@ def test_randomized_fault_soak_n7_two_faults():
     cluster.assert_ledgers_consistent()
 
 
+
+def _is_known_unresolvable_split(cluster, n):
+    """True iff the cluster's CURRENT attestations form a PREPARED-SPLIT
+    stall that is unresolvable BY DESIGN (check_in_flight docstring):
+    prepared attestations exist at the next sequence, no candidate is
+    adoptable (condition A), and a fresh proposal is not justified
+    (condition B) — covering both the sub-f+1 split and opposed
+    f+1-corroborated camps, where a hidden commit cannot be ruled out on
+    either side.  The arithmetic is recomputed here INDEPENDENTLY of
+    check_in_flight so a resolvability regression in the production code
+    cannot self-excuse a wedge."""
+    from consensus_tpu.utils.quorum import compute_quorum
+    from consensus_tpu.wire import decode_view_data, decode_view_metadata
+
+    msgs = []
+    for node in cluster.nodes.values():
+        vc = node.consensus.view_changer
+        svd = vc._prepare_view_data()
+        msgs.append(decode_view_data(svd.raw_view_data))
+    quorum, f = compute_quorum(n)
+
+    expected_seq = max(
+        (
+            decode_view_metadata(m.last_decision.metadata).latest_sequence
+            for m in msgs
+            if m.last_decision is not None and m.last_decision.metadata
+        ),
+        default=0,
+    ) + 1
+    prepared_groups: dict = {}
+    quiet = 0  # none / unprepared / wrong-seq — the B-side count
+    for m in msgs:
+        p = m.in_flight_proposal
+        if p is None or not p.metadata:
+            quiet += 1
+            continue
+        md = decode_view_metadata(p.metadata)
+        if md.latest_sequence != expected_seq or not m.in_flight_prepared:
+            quiet += 1
+            continue
+        prepared_groups[p.digest()] = prepared_groups.get(p.digest(), 0) + 1
+
+    if not prepared_groups:
+        return False  # nothing prepared: a stall here is a real bug
+    if quiet >= quorum:
+        return False  # condition B should have fired: real bug
+    prepared_total = sum(prepared_groups.values())
+    for count in prepared_groups.values():
+        arguing = prepared_total - count
+        if count >= f + 1 and len(msgs) - arguing >= quorum:
+            return False  # condition A should have adopted it: real bug
+    return True
+
+
 def _run_targeted_chaos(seed, n, durability_window=0.0,
                         leader_rotation=False):
     """Message-type-targeted chaos: random drop rules per wire kind (up to
@@ -254,13 +308,20 @@ def _run_targeted_chaos(seed, n, durability_window=0.0,
     cluster.scheduler.advance(60.0)
     floor = max(len(nd.app.ledger) for nd in cluster.nodes.values())
     submit_some(5)
-    assert cluster.scheduler.run_until(
+    progressed = cluster.scheduler.run_until(
         lambda: sum(
             1 for nd in cluster.nodes.values()
             if len(nd.app.ledger) >= floor + 1
         ) >= n - f,
         max_time=1200.0,
-    ), "cluster failed to progress after the chaos healed"
+    )
+    if not progressed:
+        # The one excuse: a prepared-split stall that is unresolvable BY
+        # DESIGN (stalling is the safe outcome; see the helper).  Anything
+        # else is a genuine liveness bug.
+        assert _is_known_unresolvable_split(cluster, n), (
+            "cluster failed to progress after the chaos healed"
+        )
     cluster.assert_ledgers_consistent()
 
 
@@ -297,7 +358,7 @@ def test_targeted_message_chaos_sweep(seed, n):
 # in-flight WAL tail's view.
 @pytest.mark.parametrize("seed,n", [(1, 4), (2, 7), (400, 4), (401, 7),
                                     (402, 4), (403, 7), (404, 4), (405, 7),
-                                    (1268, 4), (3428, 4)])
+                                    (1268, 4), (3428, 4), (4305, 4)])
 def test_targeted_message_chaos_group_commit(seed, n):
     _run_targeted_chaos(seed, n, durability_window=0.05)
 
@@ -524,13 +585,20 @@ def _run_byzantine_mutation_chaos(seed, n, durability_window=0.0,
     cluster.scheduler.advance(60.0)
     floor = max(len(nd.app.ledger) for nd in cluster.nodes.values())
     submit_some(5)
-    assert cluster.scheduler.run_until(
+    progressed = cluster.scheduler.run_until(
         lambda: sum(
             1 for nd in cluster.nodes.values()
             if len(nd.app.ledger) >= floor + 1
         ) >= n - f,
         max_time=1200.0,
-    ), "cluster failed to progress after corruption stopped"
+    )
+    if not progressed:
+        # The one excuse: a prepared-split stall that is unresolvable BY
+        # DESIGN (stalling is the safe outcome; see the helper).  Anything
+        # else is a genuine liveness bug.
+        assert _is_known_unresolvable_split(cluster, n), (
+            "cluster failed to progress after corruption stopped"
+        )
     cluster.assert_ledgers_consistent()
 
 
