@@ -171,6 +171,21 @@ class Consensus:
         # truncated away by the proposal append itself — boot there, not in
         # the checkpoint's stale view (extension beyond reference
         # consensus.go:464-504, which has the same blind spot).
+        #
+        # Endorsement view-stamping: the _commit_in_flight endorsement tail
+        # [vote, proposed, commit] stamps its ProposedRecord with the
+        # proposal's ORIGINAL view, not the view change's target.  That is
+        # safe here and deliberate: (a) the original view is <= the vote's
+        # next_view (the proposal predates the change the vote joined), so
+        # with the buried vote restored above this tail check can never
+        # drag new_view backwards; (b) the PREPARED pin requires the
+        # attestation to carry the proposal EXACTLY as commit-signed —
+        # peers match it by equality in check_in_flight, so restamping the
+        # embedded records with the target view would fork our own
+        # attestation from the signature we already minted against the
+        # original-view metadata.  The rejoin to the change's target is
+        # carried by _restore_view_change (the vote), not by this record.
+        # Pinned by tests/test_restart_recovery.py and the crash matrix.
         tail = self.state.load_in_flight_view_if_applicable()
         if tail is not None and tail[0] > new_view:
             logger.info("restoring view %d from the in-flight WAL tail", tail[0])

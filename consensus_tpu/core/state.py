@@ -128,8 +128,19 @@ class PersistedState:
 
     # --- saving ------------------------------------------------------------
 
+    @staticmethod
+    def _fault_point_of(record: SavedMessage) -> str:
+        if isinstance(record, ProposedRecord):
+            return "state.save.proposed"
+        if isinstance(record, SavedCommit):
+            return "state.save.commit"
+        if isinstance(record, SavedViewChange):
+            return "state.save.viewchange"
+        return "state.save.newview"
+
     def save(self, record: SavedMessage, on_durable=None,
-             truncate: Optional[bool] = None) -> None:
+             truncate: Optional[bool] = None, fault_point: Optional[str] = None
+             ) -> None:
         """Persist one protocol step; ``on_durable`` fires once the record
         is on stable storage (immediately for per-append fsync, deferred
         under group commit — the protocol defers its sends behind it).
@@ -140,12 +151,35 @@ class PersistedState:
         in-flight endorsement appends a ProposedRecord that implies NO new
         decision (the sequence is the contested one), and truncating there
         would erase the pending-view-change vote the crash-restore rejoin
-        depends on."""
+        depends on.
+
+        ``fault_point`` relabels this save's crash points (the endorsement
+        appends register under their own names); the seams fire only when
+        the test harness armed a FaultPlan on the WAL — one ``is None``
+        check otherwise."""
+        plan = getattr(self._wal, "fault_plan", None)
+        if plan is not None:
+            point = fault_point or self._fault_point_of(record)
+            # ".pre": the process dies before ANY effect of this step — the
+            # in-memory mutations below never survive a real crash either.
+            plan.crash(point + ".pre")
         if isinstance(record, ProposedRecord):
             self._in_flight.store_proposal(record.pre_prepare.proposal)
             self._mem_proposed, self._mem_commit = record, None
         elif isinstance(record, SavedCommit):
             self._in_flight.store_prepared(record.commit.view, record.commit.seq)
+            if not self._in_flight.is_prepared():
+                # Coupling invariant: a commit record is only ever persisted
+                # for the proposal currently in flight (the commit signature
+                # was minted against it).  If the (view, seq) stamps do not
+                # line up, the check_in_flight "unprepared attestations are
+                # no-argument" relaxation would be silently decoupled from
+                # its persist-before-sign precondition — fail loudly instead.
+                raise RuntimeError(
+                    "persist-before-sign coupling violated: commit record at "
+                    f"(view={record.commit.view}, seq={record.commit.seq}) "
+                    "does not match the in-flight proposal"
+                )
             self._mem_commit = record
         self._last_written = record
         self._wal.append(
@@ -155,6 +189,8 @@ class PersistedState:
             ),
             on_durable=on_durable,
         )
+        if plan is not None:
+            plan.crash(point + ".post")
 
     # --- boot-time peeking (pkg/consensus setViewAndSeq equivalents) -------
 
@@ -199,12 +235,36 @@ class PersistedState:
         return pp.view, dec
 
     def load_view_change_if_applicable(self) -> Optional[ViewChange]:
-        """The pending view-change vote if the log ends with one.
+        """The pending view-change vote if the log ends with one — directly,
+        or buried under the view changer's in-flight endorsement tail.
 
-        Parity: reference state.go:97-113."""
-        last = self._last_record()
-        if isinstance(last, SavedViewChange):
-            return last.view_change
+        Parity: reference state.go:97-113, EXTENDED: after
+        ``_commit_in_flight`` persists its endorsement the log reads
+        ``[..., SavedViewChange, ProposedRecord, SavedCommit]`` (both
+        endorsement appends use truncate=False precisely so the vote
+        survives).  A replica that crashes there is still mid-view-change:
+        only the vote's durability let it sign the ViewData attestation it
+        broadcast, so on restart it MUST rejoin the pending change — booting
+        from the bare in-flight tail would strand it in the contested view
+        with its vote forgotten.  The bounded backward scan is safe because
+        a *normal* ProposedRecord append truncates the log (clearing any
+        older vote): a ProposedRecord sitting ABOVE a live SavedViewChange
+        can only be a truncate=False append, i.e. the endorsement (or its
+        verified-upgrade twin), and a crash between the two endorsement
+        appends leaves the ``[..., SavedViewChange, ProposedRecord]``
+        prefix this scan also handles."""
+        idx = len(self.entries) - 1
+        if idx < 0:
+            return None
+        rec = decode_saved(self.entries[idx])
+        if isinstance(rec, SavedCommit) and idx >= 1:
+            idx -= 1
+            rec = decode_saved(self.entries[idx])
+        if isinstance(rec, ProposedRecord) and idx >= 1:
+            idx -= 1
+            rec = decode_saved(self.entries[idx])
+        if isinstance(rec, SavedViewChange):
+            return rec.view_change
         return None
 
     # --- restore-into-phase (state.go:115-247) -----------------------------

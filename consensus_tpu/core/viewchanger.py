@@ -171,7 +171,12 @@ def check_in_flight(
     means that replica never commit-signed anything at the sequence, so
     no decision it participated in is endangered by adopting the prepared
     candidate; only a prepared certificate can argue (classic PBFT's
-    max-view-prepared rule has the same character)."""
+    max-view-prepared rule has the same character).
+
+    The consolidated quorum-intersection argument for this deviation —
+    why the relaxation is safe with f byzantine replicas, and why the
+    residual sub-f+1 split below stays unresolvable — lives in SAFETY.md
+    at the repository root."""
     expected_seq = (
         max(
             (
@@ -1139,8 +1144,11 @@ class ViewChanger:
             ),
             # No truncation: this record implies no newly-decided sequence,
             # and the default truncate-on-proposal would erase the pending
-            # SavedViewChange/SavedNewView history a crash-restore needs.
+            # SavedViewChange/SavedNewView history a crash-restore needs —
+            # load_view_change_if_applicable scans back over exactly this
+            # [vote, proposed, commit] tail to rejoin the pending change.
             truncate=False,
+            fault_point="state.save.endorsement_proposed",
         )
 
         def start_after_durable() -> None:
@@ -1158,7 +1166,11 @@ class ViewChanger:
                 self.self_id, view.number, view.proposal_sequence,
             )
 
-        self._state.save(SavedCommit(commit=commit), on_durable=start_after_durable)
+        self._state.save(
+            SavedCommit(commit=commit),
+            on_durable=start_after_durable,
+            fault_point="state.save.endorsement_commit",
+        )
 
     def _rebroadcast_in_flight_commit(self, view: View, commit: Commit) -> None:
         if self._stopped or self._in_flight_view is not view or view.stopped:

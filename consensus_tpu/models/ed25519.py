@@ -384,7 +384,8 @@ class Ed25519BatchVerifier:
 
     @classmethod
     def _verify_host(cls, messages, signatures, public_keys) -> np.ndarray:
-        """Sequential host fallback via the ``cryptography`` package.
+        """Sequential host fallback: the ``cryptography`` package when
+        installed (C speed), else the pure-Python RFC 8032 reference below.
 
         Ed25519 verifiers disagree on adversarial edge cases (non-canonical
         encodings, S >= L), and in BFT a vote's validity must not depend on
@@ -392,12 +393,20 @@ class Ed25519BatchVerifier:
         strict pre-checks run here too, and all replicas must use identical
         verifier config (min_device_batch included in quorum-relevant
         paths only via config parity)."""
-        from cryptography.exceptions import InvalidSignature
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PublicKey,
-        )
-
         out = cls._canonical_ok(signatures, public_keys)
+        try:
+            from cryptography.exceptions import InvalidSignature
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PublicKey,
+            )
+        except ImportError:
+            for i in range(len(out)):
+                if out[i]:
+                    out[i] = ref_verify(
+                        bytes(public_keys[i]), bytes(signatures[i]),
+                        bytes(messages[i]),
+                    )
+            return out
         for i, (msg, sig, key) in enumerate(zip(messages, signatures, public_keys)):
             if not out[i]:
                 continue
@@ -417,4 +426,141 @@ class Ed25519BatchVerifier:
         return self._verify_host(messages, signatures, public_keys)
 
 
-__all__ = ["Ed25519BatchVerifier", "L"]
+# --- pure-Python RFC 8032 reference (host) ---------------------------------
+# Plain-integer edwards25519: keygen, sign, verify.  Serves two roles: the
+# host-verification fallback when the ``cryptography`` package is not
+# installed, and the signing backend for models.verifier.Ed25519Signer in
+# the same situation — real Ed25519 (interoperable with any conformant
+# implementation), just Python-speed.  Verification keeps the strict
+# semantics of the device kernel: S < L, canonical (y < p) encodings.
+
+_D_REF = (-121665 * pow(121666, fe.P - 2, fe.P)) % fe.P
+_BASE_Y = (4 * pow(5, fe.P - 2, fe.P)) % fe.P
+
+
+def _ref_recover_x(y: int, sign: int) -> Optional[int]:
+    x2 = (y * y - 1) * pow(_D_REF * y * y + 1, fe.P - 2, fe.P) % fe.P
+    x = pow(x2, (fe.P + 3) // 8, fe.P)
+    if (x * x - x2) % fe.P:
+        x = x * pow(2, (fe.P - 1) // 4, fe.P) % fe.P
+    if (x * x - x2) % fe.P:
+        return None
+    if x == 0 and sign:
+        return None  # RFC 8032 §5.1.3 step 4
+    if x & 1 != sign:
+        x = fe.P - x
+    return x
+
+
+_REF_IDENTITY = (0, 1, 1, 0)
+
+
+def _ref_add(p, q):
+    # Extended homogeneous coordinates, RFC 8032 §5.1.4.
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % fe.P
+    b = (y1 + x1) * (y2 + x2) % fe.P
+    c = 2 * t1 * t2 * _D_REF % fe.P
+    d = 2 * z1 * z2 % fe.P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % fe.P, g * h % fe.P, f * g % fe.P, e * h % fe.P)
+
+
+def _ref_mul(s: int, p):
+    q = _REF_IDENTITY
+    while s:
+        if s & 1:
+            q = _ref_add(q, p)
+        p = _ref_add(p, p)
+        s >>= 1
+    return q
+
+
+_BASE_POINT = (
+    _ref_recover_x(_BASE_Y, 0),
+    _BASE_Y,
+    1,
+    _ref_recover_x(_BASE_Y, 0) * _BASE_Y % fe.P,
+)
+
+
+def _ref_compress(p) -> bytes:
+    x, y, z, _ = p
+    zinv = pow(z, fe.P - 2, fe.P)
+    x, y = x * zinv % fe.P, y * zinv % fe.P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _ref_decompress(raw: bytes):
+    if len(raw) != 32:
+        return None
+    y = int.from_bytes(raw, "little")
+    sign, y = y >> 255, y & ((1 << 255) - 1)
+    if y >= fe.P:
+        return None
+    x = _ref_recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % fe.P)
+
+
+def _ref_scalars(seed: bytes) -> tuple[int, bytes]:
+    if len(seed) != 32:
+        raise ValueError("Ed25519 seed must be 32 bytes")
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:]
+
+
+def ref_public_key(seed: bytes) -> bytes:
+    """RFC 8032 §5.1.5: the 32-byte public key for a 32-byte seed."""
+    a, _ = _ref_scalars(seed)
+    return _ref_compress(_ref_mul(a, _BASE_POINT))
+
+
+def ref_sign(seed: bytes, message: bytes) -> bytes:
+    """RFC 8032 §5.1.6: the 64-byte signature R || S."""
+    a, prefix = _ref_scalars(seed)
+    a_enc = _ref_compress(_ref_mul(a, _BASE_POINT))
+    r = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % L
+    r_enc = _ref_compress(_ref_mul(r, _BASE_POINT))
+    k = int.from_bytes(
+        hashlib.sha512(r_enc + a_enc + message).digest(), "little"
+    ) % L
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")
+
+
+def ref_verify(public_key: bytes, signature: bytes, message: bytes) -> bool:
+    """RFC 8032 §5.1.7 with the device kernel's strict pre-checks."""
+    if len(signature) != 64 or len(public_key) != 32:
+        return False
+    r_enc, s_raw = signature[:32], signature[32:]
+    s = int.from_bytes(s_raw, "little")
+    if s >= L:
+        return False
+    a_pt = _ref_decompress(public_key)
+    r_pt = _ref_decompress(r_enc)
+    if a_pt is None or r_pt is None:
+        return False
+    k = int.from_bytes(
+        hashlib.sha512(r_enc + public_key + message).digest(), "little"
+    ) % L
+    lhs = _ref_mul(s, _BASE_POINT)
+    rhs = _ref_add(r_pt, _ref_mul(k, a_pt))
+    return (
+        (lhs[0] * rhs[2] - rhs[0] * lhs[2]) % fe.P == 0
+        and (lhs[1] * rhs[2] - rhs[1] * lhs[2]) % fe.P == 0
+    )
+
+
+__all__ = [
+    "Ed25519BatchVerifier",
+    "L",
+    "ref_public_key",
+    "ref_sign",
+    "ref_verify",
+]

@@ -44,15 +44,32 @@ def raw_message(data: bytes) -> bytes:
 
 
 class Ed25519Signer(Signer):
-    """This replica's signing identity (private key stays host-side)."""
+    """This replica's signing identity (private key stays host-side).
+
+    Uses the ``cryptography`` package when installed; otherwise signs with
+    the pure-Python RFC 8032 reference in :mod:`consensus_tpu.models
+    .ed25519` — same keys, same signatures, Python-speed."""
 
     def __init__(self, node_id: int, private_key_bytes: Optional[bytes] = None) -> None:
-        from cryptography.hazmat.primitives import serialization
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PrivateKey,
-        )
-
         self.node_id = node_id
+        self._key = None
+        try:
+            from cryptography.hazmat.primitives import serialization
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PrivateKey,
+            )
+        except ImportError:
+            import os
+
+            from consensus_tpu.models.ed25519 import ref_public_key, ref_sign
+
+            seed = (
+                private_key_bytes if private_key_bytes is not None
+                else os.urandom(32)
+            )
+            self.public_bytes = ref_public_key(seed)
+            self._sign_fn = lambda data, _seed=seed: ref_sign(_seed, data)
+            return
         if private_key_bytes is None:
             self._key = Ed25519PrivateKey.generate()
         else:
@@ -60,19 +77,20 @@ class Ed25519Signer(Signer):
         self.public_bytes = self._key.public_key().public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw
         )
+        self._sign_fn = self._key.sign
 
     def sign_raw(self, data: bytes) -> bytes:
         """Sign ``data`` exactly as given (no domain tag) — for embedders
         that bring their own message framing (e.g. client requests)."""
-        return self._key.sign(data)
+        return self._sign_fn(data)
 
     def sign(self, data: bytes) -> bytes:
-        return self._key.sign(raw_message(data))
+        return self._sign_fn(raw_message(data))
 
     def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature:
         return Signature(
             id=self.node_id,
-            value=self._key.sign(commit_message(proposal, aux)),
+            value=self._sign_fn(commit_message(proposal, aux)),
             msg=aux,
         )
 

@@ -410,7 +410,11 @@ class SidecarVerifierClient:
         bypass_below: int = 0,
         probe_interval: float = 10.0,
         auth_secret: Optional[bytes] = None,
+        fault_plan=None,
     ) -> None:
+        #: Optional testing FaultPlan (consensus_tpu/testing/faults.py):
+        #: arms the sidecar.send.io_error / sidecar.recv.short_read seams.
+        self.fault_plan = fault_plan
         self._address = address
         self._timeout = request_timeout
         self._connect_timeout = connect_timeout
@@ -629,6 +633,9 @@ class SidecarVerifierClient:
             # one socket timeout.  A timeout DURING sendall leaves a
             # partial frame on the wire, so that path drops the socket.
             try:
+                plan = self.fault_plan
+                if plan is not None:
+                    plan.io_error("sidecar.send.io_error")
                 _write_frame(sock, req_id, payload, mac_key, b"c2s")
             except OSError as exc:
                 with self._lock:
@@ -658,6 +665,12 @@ class SidecarVerifierClient:
     def _read_loop(self, sock: socket.socket, mac_key: Optional[bytes]) -> None:
         try:
             while True:
+                plan = self.fault_plan
+                if plan is not None and plan.trip("sidecar.recv.short_read"):
+                    # Simulate the response link dying mid-frame: the finally
+                    # block drops the socket, failing in-flight waiters over
+                    # to the local path exactly as a real short read would.
+                    return
                 try:
                     req_id, body = _read_frame(
                         sock, _MAX_FRAME, mac_key, b"s2c", patient=True

@@ -85,7 +85,12 @@ class TcpComm(Comm):
         reconnect_backoff: float = 0.5,
         connect_timeout: float = 2.0,
         auth_secret: Optional[bytes] = None,
+        fault_plan=None,
     ) -> None:
+        #: Optional testing FaultPlan (consensus_tpu/testing/faults.py):
+        #: arms the net.send.io_error / net.recv.short_read seams below.
+        #: A single ``is None`` check when unarmed.
+        self.fault_plan = fault_plan
         self.self_id = self_id
         self._addresses = dict(addresses)
         self._on_message = on_message
@@ -222,6 +227,12 @@ class TcpComm(Comm):
             return
         try:
             while not self._stopped.is_set():
+                plan = self.fault_plan
+                if plan is not None and plan.trip("net.recv.short_read"):
+                    # Simulate the link dying mid-frame: the finally block
+                    # closes the connection exactly as a real short read
+                    # below would; the sender reconnects lazily.
+                    return
                 header = _read_exact(conn, _HEADER.size)
                 if header is None:
                     return
@@ -324,6 +335,9 @@ class _Peer:
             if sock is None:
                 continue  # drop the frame; peer unreachable right now
             try:
+                plan = self._comm.fault_plan
+                if plan is not None:
+                    plan.io_error("net.send.io_error")
                 sock.sendall(frame)
             except OSError:
                 self._drop_connection()

@@ -33,6 +33,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
+import threading
 
 import numpy as np
 
@@ -112,12 +113,23 @@ def _const_bank_np() -> np.ndarray:
     ).astype(np.float32)
 
 
+#: Serializes the monkeypatch windows below: tracing swaps module-level
+#: globals (fe.constant_like / fp._SOLINAS_M / ...), so two threads tracing
+#: concurrently — or one tracing the ed25519 kernel while another traces
+#: P-256 — would see each other's patched globals or restore stale ones.
+#: Held only during tracing (first call per shape), never on cached
+#: executions.
+_INJECT_LOCK = threading.RLock()
+
+
 @contextlib.contextmanager
 def _inject_consts(bank: jnp.ndarray):
     """During kernel tracing, point field25519's constant plumbing at the
     in-kernel bank rows: ``constant_like`` looks its value up, and the 2p
     subtraction bias global becomes the traced row.  Restored on exit —
-    the XLA path keeps its baked numpy constants."""
+    the XLA path keeps its baked numpy constants.  Serialized by
+    ``_INJECT_LOCK``: the patch window mutates module globals."""
+    _INJECT_LOCK.acquire()
     lookup = {1: bank[0], fe.D2: bank[1]}
     orig_constant_like = fe.constant_like
     orig_two_p = fe._TWO_P
@@ -137,6 +149,7 @@ def _inject_consts(bank: jnp.ndarray):
     finally:
         fe.constant_like = orig_constant_like
         fe._TWO_P = orig_two_p
+        _INJECT_LOCK.release()
 
 
 def _scan_kernel(consts_ref, kd_ref, ax_ref, ay_ref, az_ref, at_ref,
@@ -249,7 +262,9 @@ def _inject_consts_p256(bank: jnp.ndarray, solinas: jnp.ndarray,
                         bias: jnp.ndarray):
     """P-256 analogue of :func:`_inject_consts`: the Solinas reduction
     matrix (every mul/square/add), the signed subtraction bias, and the
-    value constants become traced kernel inputs for the duration."""
+    value constants become traced kernel inputs for the duration.
+    Serialized by the shared ``_INJECT_LOCK``."""
+    _INJECT_LOCK.acquire()
     lookup = {1: bank[0], p256.B % fp.P: bank[1]}
     orig_constant_like = fp.constant_like
     orig_solinas = fp._SOLINAS_M
@@ -272,6 +287,7 @@ def _inject_consts_p256(bank: jnp.ndarray, solinas: jnp.ndarray,
         fp.constant_like = orig_constant_like
         fp._SOLINAS_M = orig_solinas
         fp._BIAS = orig_bias
+        _INJECT_LOCK.release()
 
 
 def _scan_kernel_p256(consts_ref, solinas_ref, bias_ref, kd_ref,

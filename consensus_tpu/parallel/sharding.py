@@ -36,6 +36,13 @@ from consensus_tpu.models.ed25519 import (
 
 BATCH_AXIS = "batch"
 
+# jax.shard_map was promoted to the top level after 0.4.x; older releases
+# ship it under jax.experimental only.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax<0.5 installs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 #: Device-layout partition specs: limb/bit arrays are (20|256, batch) —
 #: batch is the trailing axis; per-element vectors are (batch,).
 _IN_SPECS = (
@@ -71,7 +78,7 @@ def sharded_verify_fn(mesh: Mesh):
     a ``psum``-reduced valid count so the collective path is exercised."""
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=_IN_SPECS,
         out_specs=(P(BATCH_AXIS), P()),
@@ -153,7 +160,7 @@ def sharded_p256_verify_fn(mesh: Mesh):
     from consensus_tpu.models.ecdsa_p256 import verify_impl as p256_verify_impl
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=_P256_IN_SPECS,
         out_specs=(P(BATCH_AXIS), P()),

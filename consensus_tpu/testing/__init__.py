@@ -15,9 +15,21 @@ from consensus_tpu.testing.app import (
     unpack_batch,
 )
 from consensus_tpu.testing.crypto_app import ClientKeyring, CryptoApp, SignedRequestApp
+from consensus_tpu.testing.faults import (
+    CRASH_POINTS,
+    FaultPlan,
+    InjectedIOError,
+    SimulatedCrash,
+    registered_crash_points,
+)
 from consensus_tpu.testing.network import NodeComm, SimNetwork
 
 __all__ = [
+    "CRASH_POINTS",
+    "FaultPlan",
+    "InjectedIOError",
+    "SimulatedCrash",
+    "registered_crash_points",
     "ClientKeyring",
     "CryptoApp",
     "SignedRequestApp",

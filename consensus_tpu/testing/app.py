@@ -9,6 +9,7 @@ Consensus over the same WAL content.
 from __future__ import annotations
 
 import hashlib
+import os
 import struct
 from typing import Optional, Sequence
 
@@ -260,16 +261,46 @@ class Node:
         self.running = False
         #: Optional Metrics bundle handed to the next (re)build.
         self.metrics = None
+        #: Armed testing FaultPlan (consensus_tpu/testing/faults.py); attach
+        #: via arm_fault_plan so a firing crash seam tears this node down.
+        self.fault_plan = None
+
+    def arm_fault_plan(self, plan) -> None:
+        """Arm ``plan`` on this node: its crash seams will call
+        :meth:`crash` (teardown BEFORE the SimulatedCrash unwinds, so a
+        swallowed exception cannot resurrect the process), and the plan is
+        cleared on firing so a later :meth:`restart` boots clean."""
+        plan.on_crash = self._fault_crash
+        self.fault_plan = plan
+        if self.wal is not None:
+            self.wal.fault_plan = plan
+
+    def _fault_crash(self) -> None:
+        self.fault_plan = None  # the restarted process is a fresh one
+        self.crash()
 
     def start(self) -> None:
         comm = self.cluster.network.register(self.node_id, self._on_message)
         last = self.app.ledger[-1] if self.app.ledger else None
         window = self.cluster.durability_window
-        self.wal = (
-            DeferredMemWAL(self.wal_backing, self.cluster.scheduler, window)
-            if window > 0
-            else MemWAL(self.wal_backing)
-        )
+        if self.cluster.wal_dir is not None:
+            # Real file-backed WAL (fsync per append, small segments so
+            # rolls happen under test): restart re-opens the directory,
+            # repairing a torn tail exactly as a production boot would.
+            from consensus_tpu.wal.log import initialize_and_read_all
+
+            self.wal, initial = initialize_and_read_all(
+                os.path.join(self.cluster.wal_dir, f"wal-{self.node_id}"),
+                segment_max_bytes=self.cluster.wal_segment_bytes,
+            )
+        else:
+            self.wal = (
+                DeferredMemWAL(self.wal_backing, self.cluster.scheduler, window)
+                if window > 0
+                else MemWAL(self.wal_backing)
+            )
+            initial = list(self.wal_backing)
+        self.wal.fault_plan = self.fault_plan
         self.consensus = Consensus(
             config=self.config,
             scheduler=self.cluster.scheduler,
@@ -281,7 +312,7 @@ class Node:
             verifier=self.app,
             request_inspector=self.app.inspector,
             synchronizer=self.app,
-            wal_initial_content=list(self.wal_backing),
+            wal_initial_content=initial,
             last_proposal=last.proposal if last else None,
             last_signatures=last.signatures if last else (),
             metrics=self.metrics,
@@ -293,8 +324,9 @@ class Node:
         """Hard-stop: drop off the network and kill all components."""
         self.running = False
         self.cluster.network.unregister(self.node_id)
-        if isinstance(self.wal, DeferredMemWAL):
-            self.wal.abandon()  # unflushed group-commit records die with us
+        abandon = getattr(self.wal, "abandon", None)
+        if abandon is not None:
+            abandon()  # unflushed records / open fds die with the process
         if self.consensus is not None:
             self.consensus.stop()
             self.consensus = None
@@ -329,11 +361,18 @@ class Cluster:
         config_tweaks: Optional[dict] = None,
         leader_rotation: bool = False,
         durability_window: float = 0.0,
+        wal_dir: Optional[str] = None,
+        wal_segment_bytes: int = 2048,
     ) -> None:
         #: > 0 gives every node group-commit durability semantics
         #: (DeferredMemWAL): appends become durable — and their deferred
         #: sends fire — only after this many sim-seconds.
         self.durability_window = durability_window
+        #: Set to a directory to give every node a REAL file-backed WAL
+        #: (wal/log.py) under <wal_dir>/wal-<id> instead of the in-memory
+        #: one; segments deliberately tiny so rolls happen in short runs.
+        self.wal_dir = wal_dir
+        self.wal_segment_bytes = wal_segment_bytes
         self.scheduler = SimScheduler()
         self.network = SimNetwork(self.scheduler, seed=seed)
         self.network.membership = list(range(1, n + 1))

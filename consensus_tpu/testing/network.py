@@ -110,6 +110,10 @@ class SimNetwork:
     # --- transport ---------------------------------------------------------
 
     def send(self, sender: int, target: int, payload, *, is_request: bool) -> None:
+        if sender not in self._handlers:
+            return  # a crashed (unregistered) process cannot transmit:
+            # scheduler events queued by its zombie frames must not leak
+            # messages a dead replica never actually sent.
         if sender in self._disconnected or target in self._disconnected:
             return
         if (sender, target) in self._cut_links:

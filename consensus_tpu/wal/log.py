@@ -111,6 +111,7 @@ class WriteAheadLog:
         group_commit_window: float = 0.0,
         scheduler=None,
         metrics=None,
+        fault_plan=None,
     ) -> None:
         """``group_commit_window`` > 0 (requires a ``scheduler``) batches
         fsyncs: appends write immediately but durability callbacks are
@@ -127,6 +128,10 @@ class WriteAheadLog:
         #: Optional MetricsWAL bundle; gauge parity: reference
         #: pkg/wal/metrics.go:8-15 (wal_count_of_files).
         self._metrics = metrics
+        #: Optional testing FaultPlan (consensus_tpu/testing/faults.py).  The
+        #: seams below are a single ``is None`` check when unarmed — no lock,
+        #: no extra flush/fsync on the hot path.
+        self.fault_plan = fault_plan
         self._dir = directory
         self._segment_max_bytes = segment_max_bytes
         self._sync = sync
@@ -200,6 +205,25 @@ class WriteAheadLog:
             self._file = None
         self._closed = True
 
+    def abandon(self) -> None:
+        """Simulated process death: drop the file handle WITHOUT flushing
+        pending group-commit state or firing durability callbacks.  Unlike
+        :meth:`close`, records whose fsync had not yet happened are simply
+        lost — which is exactly what a crash does.  Used by the crash-matrix
+        harness; production shutdown should keep using ``close``."""
+        if self._sync_timer is not None:
+            self._sync_timer.cancel()
+            self._sync_timer = None
+        self._sync_waiters = []
+        self._sync_pending = False
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        self._closed = True
+
     # --- appending ---------------------------------------------------------
 
     def append(
@@ -218,6 +242,9 @@ class WriteAheadLog:
             raise WALError("log is closed")
         if on_durable is not None and not self._sync:
             raise WALError("on_durable requires a sync-enabled log")
+        plan = self.fault_plan
+        if plan is not None:
+            plan.crash("wal.append.pre_write")
         flags = _FLAG_TRUNCATE_TO if truncate_to else 0
         self._write_record(_TYPE_ENTRY, flags, data)
         if on_durable is not None and self._group_window:
@@ -242,6 +269,8 @@ class WriteAheadLog:
             else:
                 self._drop_old_segments()
         if self._file.tell() >= self._segment_max_bytes:
+            if plan is not None:
+                plan.crash("wal.segment.roll")
             self._start_segment(self._segment_index + 1)
         if on_durable is not None and not self._group_window:
             on_durable()  # already fsynced synchronously
@@ -293,6 +322,15 @@ class WriteAheadLog:
         frame = _HEADER.pack(len(payload), self._crc) + payload + b"\x00" * _pad(
             len(payload)
         )
+        plan = self.fault_plan
+        if plan is not None and rtype == _TYPE_ENTRY:
+            if plan.will_fire("wal.append.torn_write"):
+                # Worst-case torn write: half the frame reaches stable
+                # storage, then the process dies — repair() must chop it.
+                self._file.write(frame[: max(_HEADER.size, len(frame) // 2)])
+                self._file.flush()
+                os.fsync(self._file.fileno())
+            plan.crash("wal.append.torn_write")
         self._file.write(frame)
         self._file.flush()
         if self._sync:
@@ -305,7 +343,11 @@ class WriteAheadLog:
                         self._group_window, self.flush_group, name="wal-group-commit"
                     )
             else:
+                if plan is not None and rtype == _TYPE_ENTRY:
+                    plan.crash("wal.fsync.pre")
                 os.fsync(self._file.fileno())
+                if plan is not None and rtype == _TYPE_ENTRY:
+                    plan.crash("wal.fsync.post")
 
     def attach_metrics(self, metrics) -> None:
         """Attach a MetricsWAL bundle after construction (the facade calls

@@ -46,12 +46,14 @@ class ScriptedSynchronizer:
 class RecordingVC:
     def __init__(self):
         self.informed = []
+        self.messages = []
+        self.view_messages = []
 
     def handle_message(self, sender, msg):
-        pass
+        self.messages.append((sender, msg))
 
     def handle_view_message(self, sender, msg):
-        pass
+        self.view_messages.append((sender, msg))
 
     def start_view_change(self, view, stop_view):
         pass
@@ -90,6 +92,7 @@ class Harness:
         self.wal = MemWAL([])
         self.state = PersistedState(self.wal, in_flight, entries=[])
         self.checkpoint = Checkpoint()
+        self.monitor = _MonitorStub()
         pool = RequestPool(self.sched, ByteInspector(), PoolOptions())
         self.controller = Controller(
             scheduler=self.sched,
@@ -104,7 +107,7 @@ class Harness:
             pool=pool,
             batcher=Batcher(self.sched, pool, batch_max_count=10,
                             batch_max_bytes=10**6, batch_max_interval=0.05),
-            leader_monitor=_MonitorStub(),
+            leader_monitor=self.monitor,
             collector=StateCollector(self.sched, n=4, collect_timeout=1.0),
             state=self.state,
             in_flight=in_flight,
@@ -145,6 +148,10 @@ class Harness:
 
 
 class _MonitorStub:
+    def __init__(self):
+        self.processed = []
+        self.injected = []
+
     def change_role(self, role, view, leader):
         pass
 
@@ -152,10 +159,10 @@ class _MonitorStub:
         pass
 
     def process_msg(self, sender, msg):
-        pass
+        self.processed.append((sender, msg))
 
     def inject_artificial_heartbeat(self, sender, msg):
-        pass
+        self.injected.append((sender, msg))
 
     def heartbeat_was_sent(self):
         pass
@@ -307,3 +314,183 @@ def test_sync_does_not_clobber_fresh_view_decisions():
     h.feed_state_responses(view=2, seq=6)
     h.sched.advance(2.0)
     assert h.controller.curr_decisions_in_view == 0
+
+
+# --- table-driven routing + sync-interleaving families --------------------
+#
+# Parity model: reference internal/bft/controller_test.go message-routing
+# assertions (which collaborator each wire message reaches, and what a
+# leader vs a follower does with forwarded requests), plus the remaining
+# sync interleavings not covered above.
+
+import pytest
+
+from consensus_tpu.testing import make_request
+from consensus_tpu.types import Signature
+from consensus_tpu.wire import (
+    Commit,
+    HeartBeat,
+    HeartBeatResponse,
+    NewView,
+    PrePrepare,
+    Prepare,
+    SignedViewData,
+    ViewChange,
+)
+
+_SIG = Signature(id=1, value=b"s")
+
+#: (id, sender, message-factory, expected routing flags).  ``view`` = the
+#: running View's handle_message; ``vc_view`` = view changer's passive wire
+#: tap; ``vc`` = view changer's own protocol ingress; ``monitor`` = leader
+#: monitor; ``heartbeat`` = artificial heartbeat injected (leader traffic
+#: only); ``reply`` = a StateTransferResponse goes back to the sender.
+ROUTING_TABLE = [
+    ("preprepare-from-leader", 1,
+     lambda: PrePrepare(view=0, seq=1, proposal=proposal_at(0, 1)),
+     dict(view=True, vc_view=True, heartbeat=True)),
+    ("prepare-from-leader", 1,
+     lambda: Prepare(view=0, seq=1, digest="d"),
+     dict(view=True, vc_view=True, heartbeat=True)),
+    ("prepare-from-follower", 3,
+     lambda: Prepare(view=0, seq=1, digest="d"),
+     dict(view=True, vc_view=True, heartbeat=False)),
+    ("commit-from-follower", 4,
+     lambda: Commit(view=0, seq=1, digest="d", signature=_SIG),
+     dict(view=True, vc_view=True, heartbeat=False)),
+    ("view-change-vote", 3,
+     lambda: ViewChange(next_view=1),
+     dict(vc=True)),
+    ("signed-view-data", 3,
+     lambda: SignedViewData(raw_view_data=b"r", signer=3, signature=b"s"),
+     dict(vc=True)),
+    ("new-view", 1,
+     lambda: NewView(),
+     dict(vc=True)),
+    ("heartbeat", 1,
+     lambda: HeartBeat(view=0, seq=0),
+     dict(monitor=True)),
+    ("heartbeat-response", 3,
+     lambda: HeartBeatResponse(view=2),
+     dict(monitor=True)),
+    ("state-transfer-request", 4,
+     lambda: StateTransferRequest(),
+     dict(reply=True)),
+]
+
+
+@pytest.mark.parametrize(
+    "sender,factory,expect",
+    [row[1:] for row in ROUTING_TABLE],
+    ids=[row[0] for row in ROUTING_TABLE],
+)
+def test_message_routing(sender, factory, expect):
+    h = Harness()
+    h.start()
+    view_seen = []
+    h.controller.curr_view.handle_message = (
+        lambda s, m: view_seen.append((s, m))
+    )
+    h.controller.process_message(sender, factory())
+    assert bool(view_seen) == expect.get("view", False)
+    assert bool(h.vc.view_messages) == expect.get("vc_view", False)
+    assert bool(h.vc.messages) == expect.get("vc", False)
+    assert bool(h.monitor.processed) == expect.get("monitor", False)
+    assert bool(h.monitor.injected) == expect.get("heartbeat", False)
+    replies = [
+        (t, m) for t, m in h.sent if isinstance(m, StateTransferResponse)
+    ]
+    if expect.get("reply", False):
+        assert replies and replies[0][0] == sender
+    else:
+        assert not replies
+
+
+def test_stopped_controller_routes_nothing():
+    h = Harness()
+    h.start()
+    h.controller.stop()
+    h.vc.messages.clear()
+    h.vc.view_messages.clear()
+    h.controller.process_message(1, HeartBeat(view=0, seq=0))
+    h.controller.process_message(3, ViewChange(next_view=1))
+    assert not h.monitor.processed
+    assert not h.vc.messages
+
+
+#: Forwarded-request table: (id, start view, raw bytes, expect pooled).
+#: View 0's leader is node 1; view 1's is node 2 (the harness self id), so
+#: starting in view 1 makes us the leader.  Parity: reference
+#: controller_test.go leader/follower forwarded-request cases.
+FORWARD_TABLE = [
+    ("follower-drops-forwarded", 0, make_request("cli", 1), False),
+    ("leader-pools-forwarded", 1, make_request("cli", 2), True),
+    ("leader-rejects-unverifiable", 1, b"garbage-no-separators", False),
+]
+
+
+@pytest.mark.parametrize(
+    "view,raw,pooled_expected",
+    [row[1:] for row in FORWARD_TABLE],
+    ids=[row[0] for row in FORWARD_TABLE],
+)
+def test_forwarded_request_routing(view, raw, pooled_expected):
+    h = Harness()
+    h.start(view=view)
+    pooled = []
+    h.controller.pool.submit = lambda r, on_done=None: pooled.append(r)
+    h.controller.handle_request(3, raw)
+    assert bool(pooled) == pooled_expected
+    if pooled_expected:
+        assert pooled == [raw]
+
+
+def test_sync_result_behind_checkpoint_changes_nothing():
+    # The synchronizer answered with a decision OLDER than what we already
+    # delivered: position must not move backwards.
+    h = Harness()
+    latest = proposal_at(view=0, seq=5, decisions=2)
+    h.checkpoint.set(latest, ())
+    h.start(view=0, seq=6, dec=3)
+    h.synchronizer.response = SyncResponse(
+        latest=Decision(proposal=proposal_at(view=0, seq=3, decisions=0))
+    )
+    h.controller.sync()
+    h.sched.advance(0.05)
+    h.feed_state_responses(view=0, seq=6)
+    h.sched.advance(2.0)
+    assert h.controller.latest_seq() == 5
+    assert h.controller.curr_view.proposal_sequence == 6
+    assert h.controller.curr_view_number == 0
+
+
+def test_change_view_refuses_regression():
+    h = Harness()
+    h.start(view=2, seq=4, dec=0)
+    running = h.controller.curr_view
+    h.controller.change_view(1, 9, 0)
+    assert h.controller.curr_view_number == 2
+    assert h.controller.curr_view is running
+    assert not running.stopped
+
+
+def test_change_view_same_position_is_idempotent():
+    h = Harness()
+    h.start(view=0, seq=4, dec=1)
+    running = h.controller.curr_view
+    h.controller.change_view(0, 4, 1)
+    assert h.controller.curr_view is running, (
+        "an identical change_view must not tear down the running view"
+    )
+
+
+def test_stray_state_response_without_sync_is_ignored():
+    h = Harness()
+    h.start()
+    before = h.controller.curr_view
+    h.feed_state_responses(view=5, seq=9, senders=(1, 3, 4))
+    h.sched.advance(2.0)
+    # No sync was in progress: the stray responses must not move the view.
+    assert h.controller.curr_view is before
+    assert h.controller.curr_view_number == 0
+    assert h.vc.informed == []

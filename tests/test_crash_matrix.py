@@ -1,0 +1,494 @@
+"""Crash-matrix harness: enumerate every registered crash point under three
+schedule families, kill the victim replica there, restart it from its WAL,
+and assert the recovery invariants (no view regression, ledger prefix
+consistency, full-cluster progress after healing).
+
+Reproducing a failure: every assertion message carries the
+``family:point`` pair, the ``on_hit`` ordinal, and the derived cluster
+seed — ``FaultPlan(point, on_hit=n)`` on node 2 of a cluster built with
+that seed replays the exact same death deterministically (the scheduler
+and network are fully seeded; there is no wall clock in the sim).
+
+The last test in this file is the coverage gate: it fails if any
+registered crash point never actually fired across the whole module run,
+so a seam that is added to the catalog but never wired (or becomes
+unreachable after a refactor) turns the suite red instead of silently
+rotting.  File order is preserved (tier-1 runs with ``-p no:randomly``).
+"""
+
+import collections
+import socket
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from consensus_tpu.core.state import InFlightData, PersistedState
+from consensus_tpu.net import TcpComm
+from consensus_tpu.net.sidecar import SidecarVerifierClient, VerifySidecarServer
+from consensus_tpu.testing import (
+    Cluster,
+    FaultPlan,
+    MemWAL,
+    make_request,
+    registered_crash_points,
+)
+from consensus_tpu.wire import (
+    Commit,
+    HeartBeat,
+    ProposedRecord,
+    SavedCommit,
+    SavedViewChange,
+    decode_saved,
+)
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+#: Module-wide record of which points actually fired; the gate test at the
+#: bottom of the file audits it against the registered catalog.
+_FIRED: collections.Counter = collections.Counter()
+
+VICTIM = 2  # a follower in view 0, the next leader after a view change
+
+STATE_POINTS = registered_crash_points("state")
+WAL_POINTS = registered_crash_points("wal")
+FAMILIES = ("commit", "rotation", "viewchange")
+
+#: Fire on a later hit in the rotation family so the death lands mid-stream
+#: (after the victim has already survived the same point once).
+_ON_HIT = {"commit": 1, "rotation": 2, "viewchange": 1}
+
+
+def _seed(family: str, point: str) -> int:
+    """Deterministic per-cell cluster seed, printable and replayable."""
+    return zlib.crc32(f"{family}:{point}".encode()) % 100000
+
+
+def _build_cluster(family: str, seed: int, wal_dir=None) -> Cluster:
+    if family == "rotation":
+        return Cluster(
+            4,
+            seed=seed,
+            config_tweaks=dict(FAST, decisions_per_leader=2),
+            leader_rotation=True,
+            wal_dir=wal_dir,
+            wal_segment_bytes=512,
+        )
+    return Cluster(
+        4, seed=seed, config_tweaks=dict(FAST), wal_dir=wal_dir,
+        wal_segment_bytes=512,
+    )
+
+
+def _run_schedule(cluster: Cluster, family: str) -> None:
+    """Drive the family's workload.  The armed point may kill the victim at
+    any moment in here; the schedule keeps going regardless (the surviving
+    trio is a quorum)."""
+    if family == "viewchange":
+        # Commits are dropped, so proposals PREPARE everywhere but never
+        # decide; the complaint timeout then forces view changes while an
+        # in-flight prepared proposal exists — the regime where votes,
+        # new-views, and _commit_in_flight endorsements hit the WAL.
+        cluster.network.lose_messages = (
+            lambda target, sender, msg: isinstance(msg, Commit)
+        )
+        cluster.submit_to_all(make_request("vc", 0))
+        cluster.scheduler.advance(3.0)  # propose + prepare in view 0
+        cluster.scheduler.advance(30.0)  # complaints -> view change(s)
+        cluster.network.lose_messages = None
+        cluster.scheduler.advance(30.0)  # re-commit in the new view
+        return
+    for i in range(6):
+        cluster.submit_to_all(make_request(family[:3], i))
+        cluster.scheduler.advance(8.0)
+
+
+def _recover_and_check(cluster, victim, plan, family, point, seed, crash_info):
+    """Common postlude: restart a dead victim, heal, and demand that the
+    WHOLE cluster (victim included) orders new work on a consistent ledger
+    without the victim's view regressing below where it died."""
+    clue = (
+        f"[{family}:{point} on_hit={plan.on_hit} seed={seed}] "
+        f"fired={plan.fired} hits={dict(plan.hits)}"
+    )
+    cluster.network.lose_messages = None
+    cluster.network.heal()
+    if plan.fired is not None:
+        assert not victim.running, f"victim survived its own death {clue}"
+        victim.restart()  # boots from the WAL exactly as a real process
+    base = max(len(n.app.ledger) for n in cluster.nodes.values())
+    for i in range(3):
+        cluster.submit_to_all(make_request("rec", i))
+    target = base + 1
+    ok = cluster.scheduler.run_until(
+        lambda: all(
+            len(n.app.ledger) >= target for n in cluster.nodes.values()
+        ),
+        max_time=1800.0,
+    )
+    assert ok, f"cluster failed to recover and progress {clue}"
+    cluster.assert_ledgers_consistent()
+    if plan.fired is not None:
+        _FIRED[plan.fired[0]] += 1
+        final_view = victim.consensus.controller.curr_view_number
+        assert final_view >= crash_info["view"], (
+            f"view regressed across the crash: died at view "
+            f"{crash_info['view']}, running at {final_view} {clue}"
+        )
+
+
+def _run_cell(family, point, wal_dir=None):
+    seed = _seed(family, point)
+    cluster = _build_cluster(family, seed, wal_dir=wal_dir)
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    # Arm AFTER start so boot-time anchor writes don't consume the hit.
+    plan = FaultPlan(
+        point, on_hit=_ON_HIT[family], label=f"{family}:{point}"
+    )
+    victim.arm_fault_plan(plan)
+    crash_info = {"view": 0}
+    teardown = plan.on_crash
+
+    def on_crash():
+        crash_info["view"] = victim.consensus.controller.curr_view_number
+        teardown()
+
+    plan.on_crash = on_crash
+    _run_schedule(cluster, family)
+    _recover_and_check(cluster, victim, plan, family, point, seed, crash_info)
+
+
+@pytest.mark.parametrize("point", STATE_POINTS)
+@pytest.mark.parametrize("family", FAMILIES)
+def test_state_crash_point(family, point):
+    """state.save.* seams under each schedule, on the in-memory WAL."""
+    _run_cell(family, point)
+
+
+@pytest.mark.parametrize("point", WAL_POINTS)
+@pytest.mark.parametrize("family", FAMILIES)
+def test_wal_crash_point(family, point, tmp_path):
+    """wal.* seams need the real file-backed WAL: torn frames must be
+    chopped by repair() and fsync-boundary deaths must reopen cleanly."""
+    _run_cell(family, point, wal_dir=str(tmp_path))
+
+
+# --- the pinned regression: buried view-change vote -----------------------
+
+
+def test_crash_after_endorsement_commit_rejoins_pending_view_change():
+    """Kill the victim immediately after ``_commit_in_flight`` persists its
+    endorsement ``SavedCommit`` — the WAL now ends ``[SavedViewChange,
+    ProposedRecord, SavedCommit]`` with the vote BURIED two records deep.
+    Before the back-scan fix in ``load_view_change_if_applicable`` the boot
+    path saw only the trailing commit, silently dropped the pending vote,
+    and the restarted replica forgot it had joined the view change."""
+    family, point = "viewchange", "state.save.endorsement_commit.post"
+    seed = _seed(family, point)
+    cluster = _build_cluster(family, seed)
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    plan = FaultPlan(point, label=f"{family}:{point}")
+    victim.arm_fault_plan(plan)
+    _run_schedule(cluster, family)
+    assert plan.fired == (point, 1), (
+        f"endorsement never reached its commit append: hits={dict(plan.hits)}"
+    )
+    _FIRED[point] += 1
+
+    # The WAL tail is exactly the endorsement shape, vote buried under it.
+    # (The vote surviving UNDER the proposed record already proves the
+    # endorsement appended with truncate=False — a truncating append would
+    # have erased it from the in-memory WAL.)
+    tail = [decode_saved(e) for e in victim.wal_backing[-3:]]
+    assert isinstance(tail[0], SavedViewChange), tail
+    assert isinstance(tail[1], ProposedRecord), tail
+    assert isinstance(tail[2], SavedCommit), tail
+
+    # The restore path MUST dig the vote out (fails with None pre-fix).
+    state = PersistedState(
+        MemWAL(list(victim.wal_backing)),
+        InFlightData(),
+        entries=list(victim.wal_backing),
+    )
+    restored = state.load_view_change_if_applicable()
+    assert restored is not None, (
+        "buried SavedViewChange was not restored from the endorsement tail"
+    )
+    assert restored == tail[0].view_change
+
+    # And a full restart actually rejoins the pending change: the replica
+    # boots AT the vote's target with the vote handed to the view changer.
+    victim.restart()
+    assert victim.consensus._restore_view_change == tail[0].view_change
+    assert (
+        victim.consensus.controller.curr_view_number
+        >= tail[0].view_change.next_view
+    )
+    cluster.network.lose_messages = None
+    base = max(len(n.app.ledger) for n in cluster.nodes.values())
+    for i in range(3):
+        cluster.submit_to_all(make_request("rejoin", i))
+    assert cluster.scheduler.run_until(
+        lambda: all(
+            len(n.app.ledger) >= base + 1 for n in cluster.nodes.values()
+        ),
+        max_time=1800.0,
+    ), "restarted replica failed to rejoin the view change and make progress"
+    cluster.assert_ledgers_consistent()
+
+
+def test_crash_between_endorsement_saves_restores_proposed_only():
+    """Death BETWEEN the endorsement's two appends leaves ``[...,
+    SavedViewChange, ProposedRecord]``: the replica restores into PROPOSED
+    (not PREPARED) for the in-flight proposal and still rejoins the pending
+    change.  Safe by construction — the commit signature minted for the
+    endorsement never left the process (its broadcast is deferred behind
+    the SavedCommit durability callback that this crash preempted)."""
+    family, point = "viewchange", "state.save.endorsement_commit.pre"
+    seed = _seed(family, point)
+    cluster = _build_cluster(family, seed)
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    plan = FaultPlan(point, label=f"{family}:{point}")
+    victim.arm_fault_plan(plan)
+    _run_schedule(cluster, family)
+    assert plan.fired == (point, 1), dict(plan.hits)
+    _FIRED[point] += 1
+
+    tail = [decode_saved(e) for e in victim.wal_backing[-2:]]
+    assert isinstance(tail[0], SavedViewChange), tail
+    assert isinstance(tail[1], ProposedRecord), tail
+    state = PersistedState(
+        MemWAL(list(victim.wal_backing)),
+        InFlightData(),
+        entries=list(victim.wal_backing),
+    )
+    assert state.load_view_change_if_applicable() == tail[0].view_change
+
+    victim.restart()
+    cluster.network.lose_messages = None
+    base = max(len(n.app.ledger) for n in cluster.nodes.values())
+    for i in range(3):
+        cluster.submit_to_all(make_request("mid", i))
+    assert cluster.scheduler.run_until(
+        lambda: all(
+            len(n.app.ledger) >= base + 1 for n in cluster.nodes.values()
+        ),
+        max_time=1800.0,
+    )
+    cluster.assert_ledgers_consistent()
+
+
+# --- transport / sidecar I/O faults ---------------------------------------
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_tcp_send_io_error_drops_link_and_reconnects():
+    """An injected socket-write failure must behave like a real one: the
+    frame is lost, the link is dropped, and the writer reconnects so later
+    sends flow again."""
+    ports = _free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    got = threading.Event()
+    received = []
+    comm2 = TcpComm(2, addrs, lambda s, m, r: (received.append(m), got.set()))
+    plan = FaultPlan("net.send.io_error", label="tcp-send")
+    comm1 = TcpComm(
+        1, addrs, lambda *a: None, reconnect_backoff=0.05, fault_plan=plan
+    )
+    comm2.start()
+    comm1.start()
+    try:
+        deadline = time.time() + 10.0
+        seq = 0
+        while not got.is_set() and time.time() < deadline:
+            comm1.send_consensus(2, HeartBeat(view=7, seq=seq))
+            seq += 1
+            time.sleep(0.05)
+        assert plan.fired == ("net.send.io_error", 1)
+        assert got.is_set(), "no message arrived after the injected failure"
+        assert received[0].view == 7
+    finally:
+        comm1.stop()
+        comm2.stop()
+    _FIRED["net.send.io_error"] += 1
+
+
+def test_tcp_recv_short_read_closes_conn_sender_recovers():
+    """An inbound link dying mid-frame closes the connection server-side;
+    the sender lazily reconnects and delivery resumes."""
+    ports = _free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    got = threading.Event()
+    received = []
+    plan = FaultPlan("net.recv.short_read", label="tcp-recv")
+    comm2 = TcpComm(
+        2, addrs, lambda s, m, r: (received.append(m), got.set()),
+        fault_plan=plan,
+    )
+    comm1 = TcpComm(1, addrs, lambda *a: None, reconnect_backoff=0.05)
+    comm2.start()
+    comm1.start()
+    try:
+        deadline = time.time() + 10.0
+        seq = 0
+        while not got.is_set() and time.time() < deadline:
+            comm1.send_consensus(2, HeartBeat(view=9, seq=seq))
+            seq += 1
+            time.sleep(0.05)
+        assert plan.fired == ("net.recv.short_read", 1)
+        assert got.is_set(), "delivery never resumed after the short read"
+        assert received[0].view == 9
+    finally:
+        comm1.stop()
+        comm2.stop()
+    _FIRED["net.recv.short_read"] += 1
+
+
+class _LocalEngine:
+    """Valid iff sig == b"good"; records whether the local path served."""
+
+    def __init__(self):
+        self.host_calls = 0
+        self.batch_calls = 0
+
+    def verify_batch(self, msgs, sigs, keys):
+        self.batch_calls += 1
+        return np.array([s == b"good" for s in sigs], dtype=bool)
+
+    def verify_host(self, msgs, sigs, keys):
+        self.host_calls += 1
+        return np.array([s == b"good" for s in sigs], dtype=bool)
+
+
+def test_sidecar_send_io_error_fails_over_then_reconnects(tmp_path):
+    engine = _LocalEngine()
+    server = VerifySidecarServer(str(tmp_path / "sc.sock"), engine)
+    server.start()
+    plan = FaultPlan("sidecar.send.io_error", label="sc-send")
+    client = SidecarVerifierClient(
+        server.address, local_engine=engine, fault_plan=plan
+    )
+    try:
+        out = client.verify_batch([b"m", b"m"], [b"good", b"bad"], [b"k"] * 2)
+        # The injected write failure lands on the FIRST round trip, so the
+        # answer must come from the local fallback — still correct.
+        assert plan.fired == ("sidecar.send.io_error", 1)
+        assert list(out) == [True, False]
+        assert engine.host_calls == 1
+        # Next batch reconnects and goes through the sidecar again.
+        out2 = client.verify_batch([b"m"], [b"good"], [b"k"])
+        assert list(out2) == [True]
+        assert engine.batch_calls >= 1
+    finally:
+        client.close()
+        server.stop()
+    _FIRED["sidecar.send.io_error"] += 1
+
+
+def test_sidecar_recv_short_read_fails_over_then_reconnects(tmp_path):
+    engine = _LocalEngine()
+    server = VerifySidecarServer(str(tmp_path / "sc.sock"), engine)
+    server.start()
+    plan = FaultPlan("sidecar.recv.short_read", label="sc-recv")
+    client = SidecarVerifierClient(
+        server.address, local_engine=engine, fault_plan=plan
+    )
+    try:
+        out = client.verify_batch([b"m", b"m"], [b"bad", b"good"], [b"k"] * 2)
+        assert plan.fired == ("sidecar.recv.short_read", 1)
+        # The response link died; the local path must have served this one.
+        assert list(out) == [False, True]
+        assert engine.host_calls == 1
+        out2 = client.verify_batch([b"m"], [b"good"], [b"k"])
+        assert list(out2) == [True]
+        assert engine.batch_calls >= 1
+    finally:
+        client.close()
+        server.stop()
+    _FIRED["sidecar.recv.short_read"] += 1
+
+
+# --- zero-overhead guarantee ----------------------------------------------
+
+
+def test_unarmed_seams_change_nothing(tmp_path, monkeypatch):
+    """The no-regression assertion for the production path: a WAL with no
+    plan and a WAL with an armed-but-never-firing plan must issue the SAME
+    fsync sequence and produce byte-identical logs — the seams may observe,
+    never perturb."""
+    import consensus_tpu.wal.log as wal_log
+
+    real_fsync = wal_log.os.fsync
+    counts = {"n": 0}
+
+    def counting_fsync(fd):
+        counts["n"] += 1
+        return real_fsync(fd)
+
+    monkeypatch.setattr(wal_log.os, "fsync", counting_fsync)
+    records = [b"rec-%03d" % i * 9 for i in range(40)]
+
+    def run(dirname, plan):
+        wal = wal_log.WriteAheadLog.create(
+            str(tmp_path / dirname), segment_max_bytes=512
+        )
+        wal.fault_plan = plan
+        counts["n"] = 0
+        for rec in records:
+            wal.append(rec)
+        made = counts["n"]
+        wal.close()
+        reopened, entries = wal_log.initialize_and_read_all(
+            str(tmp_path / dirname), segment_max_bytes=512
+        )
+        reopened.close()
+        return made, list(entries)
+
+    bare_fsyncs, bare_entries = run("bare", None)
+    armed = FaultPlan("wal.fsync.pre", on_hit=10**9)  # never reached
+    armed_fsyncs, armed_entries = run("armed", armed)
+    assert armed_fsyncs == bare_fsyncs, (
+        "an armed-but-idle FaultPlan changed the fsync pattern"
+    )
+    assert armed_entries == bare_entries == records
+    # The plan observed every append without perturbing any of them.
+    assert armed.hits["wal.fsync.pre"] == len(records)
+    assert armed.fired is None
+
+
+# --- the coverage gate (must stay LAST in this file) ----------------------
+
+
+def test_every_registered_crash_point_fired():
+    """Audit the whole module run: every point in the catalog must have
+    actually fired somewhere above.  A registered-but-never-hit point means
+    a seam got disconnected (or a schedule stopped reaching it) — fail
+    loudly instead of letting the matrix silently shrink."""
+    if not _FIRED:
+        pytest.skip("matrix did not run (partial -k selection)")
+    missed = [p for p in registered_crash_points() if _FIRED[p] == 0]
+    assert not missed, (
+        f"registered crash points never fired in any schedule: {missed}; "
+        f"fired counts: {dict(_FIRED)}"
+    )

@@ -1,0 +1,150 @@
+"""Small-n byzantine-corruption chaos against REAL host Ed25519.
+
+Satellite of the crash-matrix PR.  test_soak's byzantine family runs toy
+crypto (ByteInspector); here the same corruption shapes must be shed by
+actual Ed25519 verification on the engine's host path (ref_sign/ref_verify
+pure-Python fallback when ``cryptography`` is absent), proving the
+protocol's rejection of forgeries doesn't depend on the toy verifier's
+shortcuts.
+
+Each case is pinned: re-run a failure with
+``pytest tests/test_crypto_chaos.py -k <mode>`` — the corruption stream is
+derived from ``random.Random(SEED + hash-of-mode)`` and the scheduler from
+``Cluster(seed=...)``, so replays are exact.
+"""
+
+import dataclasses
+import random
+import zlib
+
+import pytest
+
+from consensus_tpu.models import (
+    Ed25519BatchVerifier,
+    Ed25519Signer,
+    Ed25519VerifierMixin,
+)
+from consensus_tpu.models.verifier import commit_message
+from consensus_tpu.testing import Cluster, make_request
+from consensus_tpu.testing.crypto_app import CryptoApp
+from consensus_tpu.wire import Commit
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+SEED = 60493
+BYZANTINE = 4  # follower in view 0: corruption can't stall the leader
+HONEST = (1, 2, 3)
+DECISIONS = 3
+
+
+class _SigVerifier(Ed25519VerifierMixin):
+    def verify_proposal(self, proposal):
+        raise NotImplementedError  # app half lives in CryptoApp
+
+    def verify_request(self, raw):
+        raise NotImplementedError
+
+    def verification_sequence(self):
+        return 0
+
+    def requests_from_proposal(self, proposal):
+        return []
+
+
+def _flip_signature(rng, msg):
+    value = bytearray(msg.signature.value)
+    i = rng.randrange(len(value))
+    value[i] ^= 0xFF
+    return dataclasses.replace(
+        msg, signature=dataclasses.replace(msg.signature, value=bytes(value))
+    )
+
+
+def _claim_other_signer(rng, msg):
+    # Keeps the byzantine node's REAL signature bytes but claims an honest
+    # id: verification against the claimed id's registered key must fail.
+    other = rng.choice(HONEST)
+    return dataclasses.replace(
+        msg, signature=dataclasses.replace(msg.signature, id=other)
+    )
+
+
+def _zero_signature(rng, msg):
+    return dataclasses.replace(
+        msg,
+        signature=dataclasses.replace(
+            msg.signature, value=bytes(len(msg.signature.value))
+        ),
+    )
+
+
+MODES = {
+    "flip_byte": _flip_signature,
+    "claim_other_signer": _claim_other_signer,
+    "zero_signature": _zero_signature,
+}
+
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_byzantine_commit_corruption_shed_by_real_ed25519(mode):
+    seed = SEED + zlib.crc32(mode.encode()) % 1000
+    rng = random.Random(seed)
+    cluster = Cluster(4, seed=seed, config_tweaks=dict(FAST))
+    engine = Ed25519BatchVerifier(min_device_batch=10**9)  # host path: exact
+    signers = {i: Ed25519Signer(i) for i in cluster.nodes}
+    keys = {i: s.public_bytes for i, s in signers.items()}
+    for node_id, node in cluster.nodes.items():
+        node.app = CryptoApp(
+            node_id, cluster, signers[node_id], _SigVerifier(keys, engine=engine)
+        )
+
+    corrupt = MODES[mode]
+    corrupted = [0]
+
+    def mutate(sender, target, msg):
+        if sender == BYZANTINE and isinstance(msg, Commit):
+            corrupted[0] += 1
+            return corrupt(rng, msg)
+        return msg
+
+    cluster.network.mutate_send = mutate
+    cluster.start()
+
+    for i in range(DECISIONS):
+        cluster.submit_to_all(make_request("chaos", i))
+        assert cluster.run_until_ledger(
+            i + 1, node_ids=list(HONEST), max_time=600.0
+        ), f"[{mode} seed={seed}] block {i} stalled behind corrupted commits"
+    assert corrupted[0] > 0, "byzantine node never sent a commit to corrupt"
+    cluster.assert_ledgers_consistent()
+
+    # Decision quorums on honest replicas must exclude the corrupted
+    # signatures entirely (claim_other_signer forgeries land under an
+    # honest id but invalid bytes — so re-verify EVERY quorum signature
+    # against the registered keys, not just the claimed ids).
+    checker = Ed25519BatchVerifier(min_device_batch=10**9)
+    for node_id in HONEST:
+        for decision in cluster.nodes[node_id].app.ledger:
+            assert len(decision.signatures) >= 3
+            assert BYZANTINE not in {s.id for s in decision.signatures}, (
+                f"[{mode} seed={seed}] corrupted signature entered a quorum"
+            )
+            msgs = [
+                commit_message(decision.proposal, s.msg)
+                for s in decision.signatures
+            ]
+            ok = checker.verify_batch(
+                msgs,
+                [s.value for s in decision.signatures],
+                [keys[s.id] for s in decision.signatures],
+            )
+            assert ok.all(), (
+                f"[{mode} seed={seed}] ledger carries an invalid signature"
+            )

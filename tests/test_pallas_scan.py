@@ -284,3 +284,55 @@ def test_full_p256_verifier_parity_with_pallas_flag(monkeypatch):
     )
     assert out == expected
     assert out == baseline
+
+
+# --- tracing thread-safety -------------------------------------------------
+
+
+def test_concurrent_tracing_from_two_threads_is_safe():
+    """Two threads tracing ``horner_scan`` at DIFFERENT shapes concurrently:
+    each trace swaps the ``ops.ed25519`` module globals inside the
+    ``_inject_consts`` window, and without the module-level lock
+    (``pallas_scan._INJECT_LOCK``) one thread's trace can capture the other
+    thread's injected stand-ins — or the first ``finally`` can restore the
+    originals mid-swap under the second's feet.  Both traces must produce
+    the same accumulator as the XLA reference computed single-threaded."""
+    import threading
+
+    cases = {}
+    for n in (2, 4):
+        pts, scalars = _case_points_scalars(n, seed=23 + n)
+        neg = [((fe.P - x) % fe.P, y) for x, y in pts]
+        cases[n] = (_point_limbs(neg), _digits_for(scalars))
+    # References BEFORE the race: _xla_reference reads the same module
+    # globals the inject window swaps, so it must not run concurrently.
+    refs = {
+        n: _xla_reference(*limbs, kd) for n, (limbs, kd) in cases.items()
+    }
+
+    results, errors = {}, []
+    barrier = threading.Barrier(2)
+
+    def worker(n):
+        try:
+            limbs, kd = cases[n]
+            barrier.wait(timeout=30)
+            results[n] = horner_scan(*limbs, kd, tile=2, interpret=True)
+        except Exception as exc:  # surfaced below; a hang fails via join
+            errors.append((n, exc))
+
+    threads = [
+        threading.Thread(target=worker, args=(n,), name=f"trace-{n}")
+        for n in cases
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert set(results) == set(cases), "a tracing thread never finished"
+    for n, (limbs, kd) in cases.items():
+        match = np.asarray(ed.equal(results[n], refs[n]))
+        assert match.all(), (
+            f"n={n}: concurrent trace diverged at lanes {np.where(~match)[0]}"
+        )

@@ -422,3 +422,78 @@ def test_boot_view_honors_in_flight_wal_tail():
     entries3 = entries2 + [encode_saved(SavedViewChange(view_change=ViewChange(next_view=9)))]
     state3 = PersistedState(MemWAL(list(entries3)), InFlightData(), entries=entries3)
     assert state3.load_in_flight_view_if_applicable() is None
+
+
+def test_boot_restores_buried_view_change_vote_from_endorsement_tail():
+    """The buried-vote restore gap: a crash right after ``_commit_in_flight``
+    persists its endorsement leaves the log ending ``[SavedViewChange,
+    ProposedRecord, SavedCommit]``.  Before the backward scan in
+    ``load_view_change_if_applicable`` the loader looked only at the LAST
+    record, returned None, and the restarted replica forgot it had voted
+    for the pending view change — this test fails against that version."""
+    vote = ViewChange(next_view=3)
+    rec = proposed_record(view=2, seq=5)
+    sc = SavedCommit(
+        commit=Commit(
+            view=2, seq=5, digest=rec.pre_prepare.proposal.digest(),
+            signature=Signature(id=2, value=b"s"),
+        )
+    )
+    svc = SavedViewChange(view_change=vote)
+    full = [encode_saved(svc), encode_saved(rec), encode_saved(sc)]
+    # Crash AFTER the second endorsement append -> [vote, proposed, commit];
+    # crash BETWEEN the two appends -> [vote, proposed].  Both must surface
+    # the vote.
+    for entries in (full, full[:2]):
+        state = PersistedState(
+            MemWAL(list(entries)), InFlightData(), entries=list(entries)
+        )
+        assert state.load_view_change_if_applicable() == vote, entries
+
+    # The scan must NOT hallucinate a vote under ordinary tails: a normal
+    # decide path ends [ProposedRecord, SavedCommit] (the proposal append
+    # truncated everything before it) and a fresh proposal ends with just
+    # the ProposedRecord.
+    for entries in (
+        [encode_saved(rec), encode_saved(sc)],
+        [encode_saved(rec)],
+        [encode_saved(sc)],
+    ):
+        state = PersistedState(
+            MemWAL(list(entries)), InFlightData(), entries=list(entries)
+        )
+        assert state.load_view_change_if_applicable() is None, entries
+
+
+def test_boot_with_buried_vote_starts_at_vote_target_view():
+    """consensus.py::_set_view_and_seq with the endorsement tail: the
+    embedded ProposedRecord deliberately keeps the proposal's ORIGINAL view
+    stamp (restamping would fork the attestation from the commit signature
+    already minted over it — peers match it by equality in
+    ``check_in_flight``).  Safe because the original view is <= the vote's
+    target, so once the buried vote is restored the in-flight-tail check
+    cannot drag the boot view backwards — pinned here."""
+    from consensus_tpu.consensus import Consensus
+
+    vote = ViewChange(next_view=9)
+    rec = proposed_record(view=8, seq=5)  # endorsement stamped with view 8
+    sc = SavedCommit(
+        commit=Commit(
+            view=8, seq=5, digest=rec.pre_prepare.proposal.digest(),
+            signature=Signature(id=2, value=b"s"),
+        )
+    )
+    entries = [
+        encode_saved(SavedViewChange(view_change=vote)),
+        encode_saved(rec),
+        encode_saved(sc),
+    ]
+    shell = Consensus.__new__(Consensus)  # only .state is consulted
+    shell.state = PersistedState(
+        MemWAL(list(entries)), InFlightData(), entries=list(entries)
+    )
+    # Checkpoint says view 8, seq 5: the vote must win the restore point.
+    view, seq, dec = Consensus._set_view_and_seq(shell, 8, 5, 2)
+    assert view == 9, "boot view must be the buried vote's target"
+    assert seq == 5
+    assert shell._restore_view_change == vote
