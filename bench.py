@@ -285,28 +285,27 @@ def main() -> None:
         # number with an A/B experiment's result.
         metric += "_pallas"
     if not _probe_device_with_retries():
-        # Emit the last good measurement as a MACHINE-READABLE block marked
-        # stale=true — this run's own value stays 0 (a harness must never
-        # mistake the trail for this run's result), but the artifact chain
-        # keeps the measurement provenance without a human reading
-        # BASELINE.md.
+        # A wedged TPU tunnel is an infrastructure condition, not a
+        # benchmark failure: emit a MACHINE-READABLE skip record carrying
+        # the last good measurement (marked stale=true so a harness never
+        # mistakes the trail for this run's result) and exit 0 — CI lanes
+        # gate on rc, and a red lane for an unreachable device buries real
+        # regressions.
         last_good = _load_last_good(metric)
         print(
             json.dumps(
                 {
                     "metric": metric,
-                    "value": 0,
-                    "unit": "sigs/sec",
-                    "vs_baseline": 0,
-                    "error": "device unreachable (TPU tunnel wedged; "
-                             f"retried for {RETRY_WINDOW:.0f}s)",
+                    "skipped": "device-unavailable",
+                    "detail": "device unreachable (TPU tunnel wedged; "
+                              f"retried for {RETRY_WINDOW:.0f}s)",
                     "last_good": dict(last_good, stale=True)
                     if last_good
                     else None,
                 }
             )
         )
-        sys.exit(1)
+        sys.exit(0)
 
     import jax
 

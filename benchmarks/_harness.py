@@ -27,8 +27,11 @@ class RealCluster:
 
     def __init__(self):
         self.nodes = {}
+        self.sync_listeners = {}
 
     def longest_ledger(self, *, exclude):
+        # Shared-memory toy fallback (sync="toy"); the wire path replaces
+        # this with LedgerSynchronizer over TcpSyncTransport.
         best = []
         for node_id, holder in self.nodes.items():
             if node_id == exclude or not holder.running:
@@ -55,12 +58,28 @@ def start_replicas(
     make_config: Callable[[int], object],
     *,
     leader_metrics=None,
+    sync: str = "wire",
 ):
     """Boot n replicas over TCP.  Returns (cluster, replicas, comms,
-    schedulers); replica 1 gets ``leader_metrics`` if provided."""
+    schedulers); replica 1 gets ``leader_metrics`` if provided.
+
+    ``sync="wire"`` (default) gives each replica the real catch-up stack:
+    a SyncServer/SyncListener serving its ledger plus a LedgerSynchronizer
+    fetching verified chunks from peers over TCP.  ``sync="toy"`` keeps the
+    shared-memory ``TestApp.sync`` shortcut.
+    """
+    if sync not in ("wire", "toy"):
+        raise ValueError(f"unknown sync mode {sync!r}")
     from consensus_tpu.consensus import Consensus
     from consensus_tpu.net import TcpComm
     from consensus_tpu.runtime import RealtimeScheduler
+    from consensus_tpu.sync import (
+        LedgerDecisionStore,
+        LedgerSynchronizer,
+        SyncListener,
+        SyncServer,
+        TcpSyncTransport,
+    )
     from consensus_tpu.testing.app import MemWAL
 
     ports = free_ports(n)
@@ -68,9 +87,22 @@ def start_replicas(
     cluster = RealCluster()
     replicas, comms, schedulers = {}, {}, {}
 
+    # Apps (and, in wire mode, their sync listeners) come up first so every
+    # replica knows the full sync-address map before its client is built.
+    apps, stores, sync_addrs = {}, {}, {}
     for node_id in addrs:
         app = make_app(node_id, cluster)
+        apps[node_id] = app
         cluster.nodes[node_id] = Holder(app)
+        if sync == "wire":
+            store = LedgerDecisionStore(app.ledger)
+            stores[node_id] = store
+            listener = SyncListener(SyncServer(store))
+            cluster.sync_listeners[node_id] = listener
+            sync_addrs[node_id] = listener.address
+
+    for node_id in addrs:
+        app = apps[node_id]
         rt = RealtimeScheduler()
         rt.start(thread_name=f"replica-{node_id}")
         schedulers[node_id] = rt
@@ -90,6 +122,20 @@ def start_replicas(
         comm = TcpComm(node_id, addrs, make_router(node_id), reconnect_backoff=0.05)
         comm.start()
         comms[node_id] = comm
+        if sync == "wire":
+            synchronizer = LedgerSynchronizer(
+                node_id=node_id,
+                store=stores[node_id],
+                transport=TcpSyncTransport(
+                    node_id,
+                    {i: a for i, a in sync_addrs.items() if i != node_id},
+                ),
+                verifier=app,
+                nodes=list(addrs),
+                reconfig_of=cluster.reconfig_of,
+            )
+        else:
+            synchronizer = app
         consensus = Consensus(
             config=make_config(node_id),
             scheduler=rt,
@@ -100,7 +146,7 @@ def start_replicas(
             signer=app,
             verifier=app,
             request_inspector=app.inspector,
-            synchronizer=app,
+            synchronizer=synchronizer,
             metrics=leader_metrics if node_id == 1 else None,
         )
         consensus.start()
@@ -135,11 +181,15 @@ def start_feeder(leader, requests, *, inflight: int):
     return stop, exhausted
 
 
-def teardown(replicas, comms, schedulers):
+def teardown(replicas, comms, schedulers, cluster=None):
     for consensus in replicas.values():
         consensus.stop()
     for comm in comms.values():
         comm.stop()
+    if cluster is not None:
+        for listener in cluster.sync_listeners.values():
+            listener.close()
+        cluster.sync_listeners.clear()
     for rt in schedulers.values():
         try:
             rt.stop(timeout=2.0)

@@ -80,7 +80,7 @@ def main() -> None:
         )
     )
 
-    teardown(replicas, comms, schedulers)
+    teardown(replicas, comms, schedulers, cluster)
 
 
 if __name__ == "__main__":

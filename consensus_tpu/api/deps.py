@@ -163,6 +163,22 @@ class Verifier(abc.ABC):
                 out.append(None)
         return out
 
+    def verify_consenter_sigs_multi_batch(
+        self, groups: Sequence[tuple[Proposal, Sequence[Signature]]]
+    ) -> list[list[Optional[bytes]]]:
+        """Verify consenter-signature quorums over MANY proposals at once —
+        the sync client drains a whole catch-up chunk (dozens of decisions,
+        each with a quorum cert) through this single entry point.
+
+        Default loops over ``verify_consenter_sigs_batch``; TPU verifiers
+        override to flatten every (proposal, signature) pair into one
+        device batch.
+        """
+        return [
+            self.verify_consenter_sigs_batch(sigs, proposal)
+            for proposal, sigs in groups
+        ]
+
 
 # Convenience alias for implementations that only provide the batch forms.
 BatchVerifier = Verifier

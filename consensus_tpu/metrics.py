@@ -294,6 +294,37 @@ class MetricsView(_Bundle):
         )
 
 
+class MetricsSync(_Bundle):
+    """Catch-up (state transfer) instruments — consensus_tpu addition; the
+    reference has no sync subsystem to measure (Fabric's block puller lives
+    outside the library)."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_chunks_fetched = p.new_counter(
+            "sync_count_chunks_fetched", "Verified chunks applied during catch-up.", ln
+        )
+        self.count_decisions_fetched = p.new_counter(
+            "sync_count_decisions_fetched", "Decisions applied during catch-up.", ln
+        )
+        self.count_sig_verifications = p.new_counter(
+            "sync_count_sig_verifications",
+            "Quorum-cert signatures drained into batched verifier calls.",
+            ln,
+        )
+        self.sigs_per_chunk = p.new_histogram(
+            "sync_sigs_per_chunk", "Signatures batch-verified per chunk.", ln
+        )
+        self.latency_catchup = p.new_histogram(
+            "sync_latency_catchup", "Duration of one catch-up (sync) call.", ln
+        )
+        self.count_peer_demotions = p.new_counter(
+            "sync_count_peer_demotions",
+            "Peer score demotions (failed fetches + forged chunks).",
+            ln,
+        )
+
+
 class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
@@ -328,6 +359,7 @@ class Metrics:
         self.view = MetricsView(provider, label_names)
         self.view_change = MetricsViewChange(provider, label_names)
         self.wal = MetricsWAL(provider, label_names)
+        self.sync = MetricsSync(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
         """Bind embedder label values on every bundle (e.g. the channel id).
@@ -357,5 +389,6 @@ __all__ = [
     "MetricsView",
     "MetricsViewChange",
     "MetricsWAL",
+    "MetricsSync",
     "extend_label_names",
 ]

@@ -1,0 +1,33 @@
+"""Wire-native state transfer: verified, chunked catch-up behind the
+Synchronizer port.
+
+* :mod:`consensus_tpu.sync.store` — position-addressed decision storage.
+* :mod:`consensus_tpu.sync.server` — serves ranged chunks with size caps.
+* :mod:`consensus_tpu.sync.transport` — blocking fetch channels (sim + TCP).
+* :mod:`consensus_tpu.sync.client` — the verifying Synchronizer.
+"""
+
+from consensus_tpu.sync.client import (
+    LedgerSynchronizer,
+    honest_endorsement_threshold,
+)
+from consensus_tpu.sync.server import SyncServer
+from consensus_tpu.sync.store import DecisionStore, LedgerDecisionStore
+from consensus_tpu.sync.transport import (
+    InProcessSyncTransport,
+    SyncListener,
+    SyncTransport,
+    TcpSyncTransport,
+)
+
+__all__ = [
+    "DecisionStore",
+    "LedgerDecisionStore",
+    "SyncServer",
+    "SyncTransport",
+    "InProcessSyncTransport",
+    "SyncListener",
+    "TcpSyncTransport",
+    "LedgerSynchronizer",
+    "honest_endorsement_threshold",
+]

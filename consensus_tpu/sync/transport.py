@@ -1,0 +1,231 @@
+"""Sync transports: the request/response channel catch-up runs over.
+
+The consensus ``Comm`` port is fire-and-forget by contract, and
+``Synchronizer.sync()`` is called *synchronously* from inside the protocol
+(controller ``_do_sync``, the view changer) — so catch-up gets its own
+blocking fetch channel, exactly like the reference's deployment: Fabric's
+block puller opens its own gRPC connections to peers, it does not ride the
+consensus message stream.
+
+Two implementations:
+
+* :class:`InProcessSyncTransport` — for the simulated cluster.  Requests and
+  replies make a full codec round-trip through bytes and honor the
+  ``SimNetwork`` partition state in BOTH directions, so a partitioned
+  replica cannot tunnel state through a side channel, and every byte a test
+  syncs has survived encode→decode.
+* :class:`TcpSyncTransport` + :class:`SyncListener` — real sockets with
+  u32-length framing, for realtime deployments (benchmarks, the example
+  orderer).
+
+Both honor an armed :class:`~consensus_tpu.testing.faults.FaultPlan` through
+the ``sync.fetch.io_error`` (survivable fetch failure) and
+``sync.chunk.corrupt`` (reply bytes damaged in flight) seams — one ``is
+None`` check each when no plan is armed.
+"""
+
+from __future__ import annotations
+
+import abc
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Sequence, Union
+
+from consensus_tpu.sync.server import SyncServer
+from consensus_tpu.wire.codec import CodecError, decode_message, encode_message
+from consensus_tpu.wire.messages import SyncChunk, SyncRequest, SyncSnapshotMeta
+
+SyncReply = Union[SyncChunk, SyncSnapshotMeta]
+
+_FRAME = struct.Struct(">I")
+_MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+
+class SyncTransport(abc.ABC):
+    """Blocking fetch channel to peers' sync servers."""
+
+    #: Armed testing FaultPlan; None in production (one attr check per fetch).
+    fault_plan = None
+
+    @abc.abstractmethod
+    def fetch(self, peer_id: int, request: SyncRequest) -> Optional[SyncReply]:
+        """Send ``request`` to ``peer_id``; return its decoded reply, or
+        None when the peer is unreachable / errored / sent garbage."""
+
+    @abc.abstractmethod
+    def peers(self) -> Sequence[int]:
+        """Candidate peers (never includes self)."""
+
+
+def _maybe_corrupt(plan, reply_bytes: bytes) -> bytes:
+    """sync.chunk.corrupt seam: flip one byte mid-payload when armed —
+    decode must then fail closed (CodecError), never yield a wrong chunk."""
+    if plan is not None and plan.trip("sync.chunk.corrupt"):
+        pos = len(reply_bytes) // 2
+        return (
+            reply_bytes[:pos]
+            + bytes([reply_bytes[pos] ^ 0xFF])
+            + reply_bytes[pos + 1 :]
+        )
+    return reply_bytes
+
+
+class InProcessSyncTransport(SyncTransport):
+    """Sim-cluster transport: full wire round-trip against the shared
+    ``sync_servers`` registry, gated on network reachability both ways."""
+
+    def __init__(
+        self,
+        node_id: int,
+        network,
+        servers: Dict[int, SyncServer],
+        *,
+        fault_plan=None,
+    ) -> None:
+        self.node_id = node_id
+        self._network = network
+        self._servers = servers
+        self.fault_plan = fault_plan
+
+    def peers(self) -> Sequence[int]:
+        return [n for n in self._network.node_ids() if n != self.node_id]
+
+    def fetch(self, peer_id: int, request: SyncRequest) -> Optional[SyncReply]:
+        # A fetch is a request AND a reply: both directions must be up.
+        if not self._network.reachable(self.node_id, peer_id):
+            return None
+        if not self._network.reachable(peer_id, self.node_id):
+            return None
+        server = self._servers.get(peer_id)
+        if server is None:
+            return None  # peer process is down
+        plan = self.fault_plan
+        try:
+            if plan is not None:
+                plan.io_error("sync.fetch.io_error")
+            reply_bytes = server.handle_bytes(encode_message(request))
+            reply_bytes = _maybe_corrupt(plan, reply_bytes)
+            reply = decode_message(reply_bytes)
+        except (OSError, CodecError):
+            return None
+        if not isinstance(reply, (SyncChunk, SyncSnapshotMeta)):
+            return None
+        return reply
+
+
+class SyncListener:
+    """Serves a :class:`SyncServer` over TCP: one framed request, one framed
+    reply per connection (catch-up is bursty and rare; connection reuse is
+    not worth the state).  Daemon accept thread; ``close()`` stops it."""
+
+    def __init__(self, server: SyncServer, *, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.server = server
+        self._sock = socket.create_server((host, port))
+        self._sock.settimeout(0.2)
+        self.address = self._sock.getsockname()
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"sync-listener-{self.address[1]}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                with conn:
+                    conn.settimeout(5.0)
+                    raw = _read_frame(conn)
+                    if raw is None:
+                        continue
+                    reply = self.server.handle_bytes(raw)
+                    conn.sendall(_FRAME.pack(len(reply)) + reply)
+            except (OSError, CodecError):
+                continue  # bad client; keep serving others
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def _read_frame(conn: socket.socket) -> Optional[bytes]:
+    header = _read_exact(conn, _FRAME.size)
+    if header is None:
+        return None
+    (length,) = _FRAME.unpack(header)
+    if length > _MAX_FRAME_BYTES:
+        raise CodecError(f"sync frame of {length} bytes exceeds cap")
+    return _read_exact(conn, length)
+
+
+def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        part = conn.recv(n - len(buf))
+        if not part:
+            return None
+        buf += part
+    return buf
+
+
+class TcpSyncTransport(SyncTransport):
+    """Real-socket fetch channel: ``addresses`` maps peer id -> (host, port)
+    of that peer's :class:`SyncListener`."""
+
+    def __init__(
+        self,
+        node_id: int,
+        addresses: Dict[int, tuple],
+        *,
+        timeout: float = 5.0,
+        fault_plan=None,
+    ) -> None:
+        self.node_id = node_id
+        self.addresses = addresses
+        self.timeout = timeout
+        self.fault_plan = fault_plan
+
+    def peers(self) -> Sequence[int]:
+        return [n for n in sorted(self.addresses) if n != self.node_id]
+
+    def fetch(self, peer_id: int, request: SyncRequest) -> Optional[SyncReply]:
+        address = self.addresses.get(peer_id)
+        if address is None:
+            return None
+        plan = self.fault_plan
+        try:
+            if plan is not None:
+                plan.io_error("sync.fetch.io_error")
+            with socket.create_connection(address, timeout=self.timeout) as conn:
+                payload = encode_message(request)
+                conn.sendall(_FRAME.pack(len(payload)) + payload)
+                reply_bytes = _read_frame(conn)
+            if reply_bytes is None:
+                return None
+            reply_bytes = _maybe_corrupt(plan, reply_bytes)
+            reply = decode_message(reply_bytes)
+        except (OSError, CodecError):
+            return None
+        if not isinstance(reply, (SyncChunk, SyncSnapshotMeta)):
+            return None
+        return reply
+
+
+__all__ = [
+    "SyncTransport",
+    "SyncReply",
+    "InProcessSyncTransport",
+    "SyncListener",
+    "TcpSyncTransport",
+]

@@ -26,6 +26,12 @@ from consensus_tpu.config import Configuration
 from consensus_tpu.consensus import Consensus
 from consensus_tpu.core.view import Phase  # noqa: F401  (re-export convenience)
 from consensus_tpu.runtime.scheduler import SimScheduler
+from consensus_tpu.sync import (
+    InProcessSyncTransport,
+    LedgerDecisionStore,
+    LedgerSynchronizer,
+    SyncServer,
+)
 from consensus_tpu.testing.network import NodeComm, SimNetwork
 from consensus_tpu.types import (
     Decision,
@@ -231,7 +237,11 @@ class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
     def auxiliary_data(self, msg: bytes) -> bytes:
         return msg
 
-    # Synchronizer: replay missing decisions from the most advanced peer.
+    # Synchronizer (TOY fallback, ``Cluster(sync_mode="toy")``): replay
+    # missing decisions straight out of the most advanced peer's in-memory
+    # ledger — no wire protocol, no verification.  Kept for unit tests that
+    # don't start transports; clusters default to the real wire path
+    # (consensus_tpu/sync/), built per node in :meth:`Node.start`.
     # Parity: reference test/test_app.go:327-371.
     def sync(self) -> SyncResponse:
         best = self.cluster.longest_ledger(exclude=self.node_id)
@@ -264,6 +274,10 @@ class Node:
         #: Armed testing FaultPlan (consensus_tpu/testing/faults.py); attach
         #: via arm_fault_plan so a firing crash seam tears this node down.
         self.fault_plan = None
+        #: Wire-sync components (sync_mode="wire"): rebuilt on every start
+        #: over the surviving app ledger.
+        self.sync_server: Optional[SyncServer] = None
+        self.synchronizer = None
 
     def arm_fault_plan(self, plan) -> None:
         """Arm ``plan`` on this node: its crash seams will call
@@ -274,6 +288,9 @@ class Node:
         self.fault_plan = plan
         if self.wal is not None:
             self.wal.fault_plan = plan
+        if isinstance(self.synchronizer, LedgerSynchronizer):
+            self.synchronizer.fault_plan = plan
+            self.synchronizer.transport.fault_plan = plan
 
     def _fault_crash(self) -> None:
         self.fault_plan = None  # the restarted process is a fresh one
@@ -301,6 +318,32 @@ class Node:
             )
             initial = list(self.wal_backing)
         self.wal.fault_plan = self.fault_plan
+        if self.cluster.sync_mode == "wire":
+            # Real catch-up path: this node serves its ledger to peers and
+            # fetches+verifies chunks over the (simulated) wire — no reads
+            # of peer memory; every synced byte crossed the codec.
+            store = LedgerDecisionStore(self.app.ledger)
+            self.sync_server = SyncServer(store)
+            self.cluster.sync_servers[self.node_id] = self.sync_server
+            transport = InProcessSyncTransport(
+                self.node_id,
+                self.cluster.network,
+                self.cluster.sync_servers,
+                fault_plan=self.fault_plan,
+            )
+            self.synchronizer = LedgerSynchronizer(
+                node_id=self.node_id,
+                store=store,
+                transport=transport,
+                verifier=self.app,
+                nodes=self.cluster.network.node_ids,
+                reconfig_of=self.cluster.reconfig_of,
+                metrics=self.metrics.sync if self.metrics is not None else None,
+                fault_plan=self.fault_plan,
+                now=self.cluster.scheduler.now,
+            )
+        else:
+            self.synchronizer = self.app
         self.consensus = Consensus(
             config=self.config,
             scheduler=self.cluster.scheduler,
@@ -311,7 +354,7 @@ class Node:
             signer=self.app,
             verifier=self.app,
             request_inspector=self.app.inspector,
-            synchronizer=self.app,
+            synchronizer=self.synchronizer,
             wal_initial_content=initial,
             last_proposal=last.proposal if last else None,
             last_signatures=last.signatures if last else (),
@@ -324,6 +367,8 @@ class Node:
         """Hard-stop: drop off the network and kill all components."""
         self.running = False
         self.cluster.network.unregister(self.node_id)
+        self.cluster.sync_servers.pop(self.node_id, None)
+        self.sync_server = None
         abandon = getattr(self.wal, "abandon", None)
         if abandon is not None:
             abandon()  # unflushed records / open fds die with the process
@@ -363,6 +408,7 @@ class Cluster:
         durability_window: float = 0.0,
         wal_dir: Optional[str] = None,
         wal_segment_bytes: int = 2048,
+        sync_mode: str = "wire",
     ) -> None:
         #: > 0 gives every node group-commit durability semantics
         #: (DeferredMemWAL): appends become durable — and their deferred
@@ -373,6 +419,17 @@ class Cluster:
         #: one; segments deliberately tiny so rolls happen in short runs.
         self.wal_dir = wal_dir
         self.wal_segment_bytes = wal_segment_bytes
+        #: "wire" (default) gives every node the real catch-up subsystem
+        #: (consensus_tpu/sync/: LedgerSynchronizer over an in-process wire
+        #: transport with full codec round-trips and quorum-cert
+        #: verification); "toy" opts back into TestApp.sync's direct
+        #: peer-memory replay for unit tests that bypass transports.
+        if sync_mode not in ("wire", "toy"):
+            raise ValueError(f"unknown sync_mode {sync_mode!r}")
+        self.sync_mode = sync_mode
+        #: node id -> live SyncServer (wire mode); a crashed node serves
+        #: nothing, exactly like its consensus ingress.
+        self.sync_servers: dict[int, SyncServer] = {}
         self.scheduler = SimScheduler()
         self.network = SimNetwork(self.scheduler, seed=seed)
         self.network.membership = list(range(1, n + 1))

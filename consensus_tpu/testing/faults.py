@@ -105,6 +105,23 @@ register_crash_point("net.recv.short_read", "inbound link dies mid-frame")
 register_crash_point("sidecar.send.io_error", "sidecar request write fails")
 register_crash_point("sidecar.recv.short_read", "sidecar response link dies")
 
+# sync/ — the catch-up path (client + transport).  The client seam is a
+# process death at the worst moment (a chunk applied, the next not yet
+# fetched — resume must start from the store height, not refetch or skip);
+# the transport seams are survivable fetch failures the peer-scoring loop
+# must absorb.
+register_crash_point(
+    "sync.client.chunk_boundary",
+    "death right after a verified chunk is applied to the store",
+)
+register_crash_point(
+    "sync.fetch.io_error", "sync fetch fails mid-flight (socket-level error)"
+)
+register_crash_point(
+    "sync.chunk.corrupt",
+    "a fetched chunk's bytes arrive corrupted (decode must fail closed)",
+)
+
 
 class FaultPlan:
     """One replica's armed fault: fire at the ``on_hit``-th hit of

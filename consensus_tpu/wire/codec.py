@@ -39,6 +39,9 @@ from consensus_tpu.wire.messages import (
     SignedViewData,
     StateTransferRequest,
     StateTransferResponse,
+    SyncChunk,
+    SyncRequest,
+    SyncSnapshotMeta,
     ViewChange,
     ViewData,
     ViewMetadata,
@@ -326,8 +329,62 @@ def _r_sts(r: _Reader) -> StateTransferResponse:
     return StateTransferResponse(view_num=view_num, sequence=sequence)
 
 
+def _w_sync_request(w: _Writer, m: SyncRequest) -> None:
+    w.u64(m.from_seq)
+    w.u64(m.to_seq)
+
+
+def _r_sync_request(r: _Reader) -> SyncRequest:
+    from_seq = r.u64()
+    to_seq = r.u64()
+    return SyncRequest(from_seq=from_seq, to_seq=to_seq)
+
+
+def _w_sync_chunk(w: _Writer, m: SyncChunk) -> None:
+    if len(m.decisions) != len(m.quorum_certs):
+        raise CodecError(
+            f"SyncChunk decisions/quorum_certs length mismatch: "
+            f"{len(m.decisions)} != {len(m.quorum_certs)}"
+        )
+    w.u64(m.from_seq)
+    w.u64(m.height)
+    w.seq(m.decisions, lambda p: _w_proposal(w, p))
+    w.seq(
+        m.quorum_certs,
+        lambda cert: w.seq(cert, lambda s: _w_signature(w, s)),
+    )
+
+
+def _r_sync_chunk(r: _Reader) -> SyncChunk:
+    from_seq = r.u64()
+    height = r.u64()
+    decisions = r.seq(lambda: _r_proposal(r))
+    certs = r.seq(lambda: r.seq(lambda: _r_signature(r)))
+    if len(decisions) != len(certs):
+        raise CodecError(
+            f"SyncChunk decisions/quorum_certs length mismatch: "
+            f"{len(decisions)} != {len(certs)}"
+        )
+    return SyncChunk(
+        from_seq=from_seq, height=height, decisions=decisions, quorum_certs=certs
+    )
+
+
+def _w_sync_snapshot_meta(w: _Writer, m: SyncSnapshotMeta) -> None:
+    w.u64(m.height)
+    w.text(m.last_digest)
+
+
+def _r_sync_snapshot_meta(r: _Reader) -> SyncSnapshotMeta:
+    height = r.u64()
+    last_digest = r.text()
+    return SyncSnapshotMeta(height=height, last_digest=last_digest)
+
+
 # Tag assignments mirror the reference's oneof field numbers
-# (smartbftprotos/messages.proto:15-26) for easy cross-auditing.
+# (smartbftprotos/messages.proto:15-26) for easy cross-auditing; tags 11-13
+# are ours — the reference has no sync wire protocol (Fabric's block puller
+# fills that role outside the library).
 _MESSAGE_CODECS: dict[int, tuple[type, Callable, Callable]] = {
     1: (PrePrepare, _w_pre_prepare, _r_pre_prepare),
     2: (Prepare, _w_prepare, _r_prepare),
@@ -339,6 +396,9 @@ _MESSAGE_CODECS: dict[int, tuple[type, Callable, Callable]] = {
     8: (HeartBeatResponse, _w_heart_beat_response, _r_heart_beat_response),
     9: (StateTransferRequest, _w_str, _r_str),
     10: (StateTransferResponse, _w_sts, _r_sts),
+    11: (SyncRequest, _w_sync_request, _r_sync_request),
+    12: (SyncChunk, _w_sync_chunk, _r_sync_chunk),
+    13: (SyncSnapshotMeta, _w_sync_snapshot_meta, _r_sync_snapshot_meta),
 }
 
 _TAG_BY_TYPE = {cls: tag for tag, (cls, _, _) in _MESSAGE_CODECS.items()}

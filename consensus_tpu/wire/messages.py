@@ -175,6 +175,51 @@ class StateTransferResponse:
     sequence: int
 
 
+# --- sync (catch-up) messages ---------------------------------------------
+# The reference delegates state transfer entirely to the application (Fabric's
+# block puller speaks the Deliver API on its own connections).  These three
+# messages are our equivalent of that side protocol: they travel on the sync
+# channel (consensus_tpu/sync/transport.py), never on the consensus Comm.
+
+
+@dataclass(frozen=True)
+class SyncRequest:
+    """Ask a peer for decided proposals in the position range
+    ``[from_seq, to_seq]`` (1-based, inclusive).  ``to_seq == 0`` is a
+    metadata probe: the server answers :class:`SyncSnapshotMeta` only.
+    """
+
+    from_seq: int
+    to_seq: int = 0
+
+
+@dataclass(frozen=True)
+class SyncChunk:
+    """A server's bounded answer to a ranged :class:`SyncRequest`.
+
+    ``decisions[i]`` is the proposal at position ``from_seq + i`` and
+    ``quorum_certs[i]`` its commit-signature quorum — kept as parallel
+    sequences so a client can drain every cert in the chunk into one
+    batched verifier call.  ``height`` is the server's chain height at
+    reply time (flow control: the client learns how far behind it still
+    is without a second probe).
+    """
+
+    from_seq: int
+    height: int
+    decisions: tuple[Proposal, ...] = ()
+    quorum_certs: tuple[tuple[Signature, ...], ...] = ()
+
+
+@dataclass(frozen=True)
+class SyncSnapshotMeta:
+    """A server's chain snapshot metadata: height and the digest of the
+    decision at the tip (empty when the chain is empty)."""
+
+    height: int
+    last_digest: str = ""
+
+
 #: The "Message oneof": anything a replica may put on the wire.
 ConsensusMessage = Union[
     PrePrepare,
@@ -187,6 +232,9 @@ ConsensusMessage = Union[
     HeartBeatResponse,
     StateTransferRequest,
     StateTransferResponse,
+    SyncRequest,
+    SyncChunk,
+    SyncSnapshotMeta,
 ]
 
 
@@ -282,6 +330,15 @@ def msg_to_string(msg: ConsensusMessage) -> str:
         return "<StateTransferRequest>"
     if isinstance(msg, StateTransferResponse):
         return f"<StateTransferResponse view={msg.view_num} seq={msg.sequence}>"
+    if isinstance(msg, SyncRequest):
+        return f"<SyncRequest from={msg.from_seq} to={msg.to_seq}>"
+    if isinstance(msg, SyncChunk):
+        return (
+            f"<SyncChunk from={msg.from_seq} n={len(msg.decisions)} "
+            f"height={msg.height}>"
+        )
+    if isinstance(msg, SyncSnapshotMeta):
+        return f"<SyncSnapshotMeta height={msg.height} tip={msg.last_digest[:8]}>"
     return repr(msg)
 
 
@@ -299,6 +356,9 @@ __all__ = [
     "HeartBeatResponse",
     "StateTransferRequest",
     "StateTransferResponse",
+    "SyncRequest",
+    "SyncChunk",
+    "SyncSnapshotMeta",
     "ConsensusMessage",
     "ProposedRecord",
     "SavedCommit",

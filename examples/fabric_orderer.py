@@ -173,6 +173,11 @@ class FabricShapedOrderer(TestApp):
     def verify_consenter_sigs_batch(self, signatures, proposal):
         return self._verifier.verify_consenter_sigs_batch(signatures, proposal)
 
+    def verify_consenter_sigs_multi_batch(self, groups):
+        # Catch-up path: drain a whole sync chunk's certs through the
+        # engine in one batch instead of the ABC's per-proposal loop.
+        return self._verifier.verify_consenter_sigs_multi_batch(groups)
+
     def verify_signature(self, signature) -> None:
         self._verifier.verify_signature(signature)
 
@@ -256,7 +261,7 @@ def main() -> None:
             }
         )
     )
-    teardown(replicas, comms, schedulers)
+    teardown(replicas, comms, schedulers, cluster)
 
 
 if __name__ == "__main__":

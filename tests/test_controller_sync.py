@@ -484,6 +484,66 @@ def test_change_view_same_position_is_idempotent():
     )
 
 
+#: _deliver_checked guard table (controller.py:443-466): a delivery racing
+#: a completed sync must not re-deliver — it syncs instead and advances the
+#: checkpoint from the sync response.  Cases: (id, checkpointed seq or None,
+#: delivered seq, sync-response factory, expect).
+DELIVER_CHECKED_TABLE = [
+    ("fresh-node-delivers", None, 1,
+     lambda: SyncResponse(latest=None, reconfig=Reconfig()),
+     dict(delivered=True, sync_calls=0, checkpoint_seq=1)),
+    ("ahead-of-checkpoint-delivers", 5, 6,
+     lambda: SyncResponse(latest=None, reconfig=Reconfig()),
+     dict(delivered=True, sync_calls=0, checkpoint_seq=6)),
+    ("equal-seq-syncs-instead", 5, 5,
+     lambda: SyncResponse(
+         latest=Decision(proposal=proposal_at(0, 7, 1)), reconfig=Reconfig()
+     ),
+     dict(delivered=False, sync_calls=1, checkpoint_seq=7)),
+    ("behind-checkpoint-syncs-instead", 5, 3,
+     lambda: SyncResponse(
+         latest=Decision(proposal=proposal_at(0, 8, 1)), reconfig=Reconfig()
+     ),
+     dict(delivered=False, sync_calls=1, checkpoint_seq=8)),
+    ("sync-learned-nothing-keeps-checkpoint", 5, 5,
+     lambda: SyncResponse(latest=None, reconfig=Reconfig()),
+     dict(delivered=False, sync_calls=1, checkpoint_seq=5)),
+    ("sync-reconfig-propagates", 5, 4,
+     lambda: SyncResponse(
+         latest=Decision(proposal=proposal_at(0, 9, 1)),
+         reconfig=Reconfig(in_latest_decision=True, current_nodes=(1, 2, 3)),
+     ),
+     dict(delivered=False, sync_calls=1, checkpoint_seq=9,
+          reconfig_nodes=(1, 2, 3))),
+]
+
+
+@pytest.mark.parametrize(
+    "checkpointed,delivered_seq,response_factory,expect",
+    [row[1:] for row in DELIVER_CHECKED_TABLE],
+    ids=[row[0] for row in DELIVER_CHECKED_TABLE],
+)
+def test_deliver_checked_guard(checkpointed, delivered_seq, response_factory, expect):
+    h = Harness()
+    if checkpointed is not None:
+        h.checkpoint.set(proposal_at(view=0, seq=checkpointed, decisions=1), ())
+        h.start(view=0, seq=checkpointed + 1, dec=1)
+    else:
+        h.start()
+    h.synchronizer.response = response_factory()
+    before_ledger = len(h.app.ledger)
+
+    reconfig = h.controller.deliver(
+        proposal_at(view=0, seq=delivered_seq, decisions=1), ()
+    )
+
+    delivered = len(h.app.ledger) > before_ledger
+    assert delivered == expect["delivered"]
+    assert h.synchronizer.calls == expect["sync_calls"]
+    assert h.controller.latest_seq() == expect["checkpoint_seq"]
+    assert reconfig.current_nodes == expect.get("reconfig_nodes", ())
+
+
 def test_stray_state_response_without_sync_is_ignored():
     h = Harness()
     h.start()

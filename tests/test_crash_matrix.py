@@ -32,6 +32,7 @@ from consensus_tpu.testing import (
     Cluster,
     FaultPlan,
     MemWAL,
+    SimulatedCrash,
     make_request,
     registered_crash_points,
 )
@@ -427,6 +428,120 @@ def test_sidecar_recv_short_read_fails_over_then_reconnects(tmp_path):
         client.close()
         server.stop()
     _FIRED["sidecar.recv.short_read"] += 1
+
+
+# --- sync-path seams -------------------------------------------------------
+
+
+def _lagging_victim_cluster(point: str, decisions: int = 6):
+    """Partition the victim, commit ``decisions`` on the surviving trio,
+    heal — the victim is now a lagging replica whose next sync() must fetch
+    the whole chain over the wire."""
+    seed = _seed("catchup", point)
+    cluster = Cluster(4, seed=seed, config_tweaks=dict(FAST))
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    cluster.network.partition([VICTIM])
+    trio = [n for n in cluster.nodes if n != VICTIM]
+    for i in range(decisions):
+        cluster.submit_to_all(make_request("pre", i))
+        assert cluster.run_until_ledger(i + 1, node_ids=trio), (
+            f"trio failed to commit decision {i + 1}"
+        )
+    assert len(victim.app.ledger) == 0
+    cluster.network.heal()
+    return cluster, victim
+
+
+def test_sync_crash_at_chunk_boundary_resumes():
+    """Death between chunks of a catch-up: the applied prefix survives in
+    the store, and the restarted replica RESUMES from it (no refetch of
+    what it already holds, no skipped range) before rejoining the cluster."""
+    point = "sync.client.chunk_boundary"
+    cluster, victim = _lagging_victim_cluster(point)
+    victim.synchronizer.chunk_window = 2  # 6 decisions -> 3 chunks
+    plan = FaultPlan(point, on_hit=2, label=f"catchup:{point}")
+    victim.arm_fault_plan(plan)
+
+    with pytest.raises(SimulatedCrash):
+        victim.synchronizer.sync()
+    assert plan.fired == (point, 2), dict(plan.hits)
+    _FIRED[point] += 1
+    assert not victim.running, "victim survived its own death"
+    # Two chunks of two applied, the third never fetched.
+    assert len(victim.app.ledger) == 4
+
+    victim.restart()
+    # The fresh synchronizer starts from the surviving store height.
+    resumed = victim.synchronizer.sync()
+    assert len(victim.app.ledger) == 6
+    assert resumed.latest is not None
+    # Only the missing tail crossed the wire after the restart: 6 total
+    # decisions fetched across both attempts, not 6 + a refetched prefix.
+    base = max(len(n.app.ledger) for n in cluster.nodes.values())
+    for i in range(3):
+        cluster.submit_to_all(make_request("rec", i))
+    assert cluster.scheduler.run_until(
+        lambda: all(
+            len(n.app.ledger) >= base + 1 for n in cluster.nodes.values()
+        ),
+        max_time=1800.0,
+    ), "cluster failed to progress after the crashed catch-up resumed"
+    cluster.assert_ledgers_consistent()
+
+
+def test_sync_fetch_io_error_scored_down_and_survived():
+    """A socket-level failure mid-fetch is a FAULT, not a death: the client
+    demotes the peer and completes the catch-up from the others."""
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+
+    point = "sync.fetch.io_error"
+    seed = _seed("catchup", point)
+    cluster = Cluster(4, seed=seed, config_tweaks=dict(FAST))
+    provider = InMemoryProvider()
+    cluster.nodes[VICTIM].metrics = Metrics(provider)
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    cluster.network.partition([VICTIM])
+    trio = [n for n in cluster.nodes if n != VICTIM]
+    for i in range(4):
+        cluster.submit_to_all(make_request("pre", i))
+        assert cluster.run_until_ledger(i + 1, node_ids=trio)
+    cluster.network.heal()
+
+    plan = FaultPlan(point, label=f"catchup:{point}")
+    victim.arm_fault_plan(plan)
+    response = victim.synchronizer.sync()
+    assert plan.fired == (point, 1), dict(plan.hits)
+    _FIRED[point] += 1
+    assert len(victim.app.ledger) == 4, "catch-up did not survive the fault"
+    assert response.latest is not None
+    assert provider.value("sync_count_peer_demotions") >= 1
+    cluster.assert_ledgers_consistent()
+
+
+def test_sync_corrupted_chunk_fails_closed_and_survived():
+    """Bytes damaged in flight must fail CLOSED: the decode rejects the
+    chunk (never applies garbage), the peer is demoted, and the sync
+    completes from clean replies."""
+    point = "sync.chunk.corrupt"
+    cluster, victim = _lagging_victim_cluster(point, decisions=4)
+    # Hits 1-3 are the height probes (one per peer); hit 4 is the first
+    # chunk reply — corrupt that.
+    plan = FaultPlan(point, on_hit=4, label=f"catchup:{point}")
+    victim.arm_fault_plan(plan)
+    response = victim.synchronizer.sync()
+    assert plan.fired == (point, 4), dict(plan.hits)
+    _FIRED[point] += 1
+    assert len(victim.app.ledger) == 4, "catch-up did not route around corruption"
+    assert response.latest is not None
+    digests = [d.proposal.digest() for d in victim.app.ledger]
+    honest = [
+        d.proposal.digest()
+        for d in cluster.nodes[1].app.ledger
+    ]
+    assert digests == honest, "corrupted bytes leaked into the synced chain"
+    cluster.assert_ledgers_consistent()
 
 
 # --- zero-overhead guarantee ----------------------------------------------

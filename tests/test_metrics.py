@@ -56,6 +56,55 @@ def test_instrument_name_parity_with_reference():
     assert not missing, f"reference instruments absent: {sorted(missing)}"
 
 
+def test_sync_family_instruments_exist():
+    """The sync bundle (no reference counterpart — the catch-up subsystem
+    is ours) registers its instruments under the sync_ prefix."""
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+
+    provider = InMemoryProvider()
+    m = Metrics(provider)
+    for name in (
+        "sync_count_chunks_fetched", "sync_count_decisions_fetched",
+        "sync_count_sig_verifications", "sync_count_peer_demotions",
+    ):
+        assert name in provider.instruments, name
+    assert m.sync.count_chunks_fetched is not None
+    # Histograms register on first observation in the in-memory provider.
+    m.sync.sigs_per_chunk.observe(12)
+    m.sync.latency_catchup.observe(0.5)
+    assert provider.observations("sync_sigs_per_chunk") == [12]
+    assert len(provider.observations("sync_latency_catchup")) == 1
+
+
+def test_sync_metrics_record_catchup():
+    """An instrumented lagging replica records the whole catch-up story:
+    chunks, decisions, and batched signature verifications per chunk."""
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+    from consensus_tpu.testing import Cluster, make_request
+
+    provider = InMemoryProvider()
+    cluster = Cluster(4)
+    victim, trio = 2, [1, 3, 4]
+    cluster.nodes[victim].metrics = Metrics(provider)
+    cluster.start()
+    cluster.network.partition([victim])
+    for i in range(3):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, node_ids=trio)
+    assert cluster.nodes[victim].app.ledger == []
+    cluster.network.heal()
+
+    cluster.nodes[victim].synchronizer.sync()
+
+    assert len(cluster.nodes[victim].app.ledger) == 3
+    assert provider.value("sync_count_chunks_fetched") == 1
+    assert provider.value("sync_count_decisions_fetched") == 3
+    # 3 decisions x 3-signature commit certs, one batched call.
+    assert provider.value("sync_count_sig_verifications") == 9
+    assert provider.observations("sync_sigs_per_chunk") == [9]
+    assert len(provider.observations("sync_latency_catchup")) == 1
+
+
 def test_label_extension_per_channel():
     """Embedder label dimensions (reference pkg/api/metrics.go:16-68):
     with_labels binds values, series are tracked independently."""

@@ -63,6 +63,31 @@ def test_view_change_while_node_partitioned():
     _assert_no_double_delivery(cluster)
 
 
+def test_wire_sync_is_default_and_toy_remains_optin():
+    """The cluster wires the real catch-up subsystem (LedgerSynchronizer
+    over the in-process wire transport) by default; the shared-memory toy
+    stays available behind ``sync_mode="toy"`` and still passes the same
+    partition-heal-sync scenario."""
+    from consensus_tpu.sync import LedgerSynchronizer
+    from consensus_tpu.testing import TestApp
+
+    for mode, expected in (("wire", LedgerSynchronizer), ("toy", TestApp)):
+        cluster = Cluster(4, config_tweaks=FAST, sync_mode=mode)
+        cluster.start()
+        assert isinstance(cluster.nodes[2].synchronizer, expected), mode
+
+        cluster.network.partition([4])
+        cluster.submit_to_all(make_request("m-%s" % mode, 0))
+        assert cluster.run_until_ledger(1, node_ids=[1, 2, 3], max_time=300.0)
+        assert len(cluster.nodes[4].app.ledger) == 0
+        cluster.network.heal()
+
+        response = cluster.nodes[4].synchronizer.sync()
+        assert len(cluster.nodes[4].app.ledger) == 1, mode
+        assert response.latest is not None
+        cluster.assert_ledgers_consistent()
+
+
 def test_leader_partitioned_after_decision_heals_and_syncs():
     """The leader is partitioned away AFTER a decision (it stays alive and
     keeps believing it leads); the rest view-change and keep ordering; on
