@@ -59,6 +59,7 @@ def start_replicas(
     *,
     leader_metrics=None,
     sync: str = "wire",
+    make_wal=None,
 ):
     """Boot n replicas over TCP.  Returns (cluster, replicas, comms,
     schedulers); replica 1 gets ``leader_metrics`` if provided.
@@ -67,6 +68,10 @@ def start_replicas(
     a SyncServer/SyncListener serving its ledger plus a LedgerSynchronizer
     fetching verified chunks from peers over TCP.  ``sync="toy"`` keeps the
     shared-memory ``TestApp.sync`` shortcut.
+
+    ``make_wal(node_id, scheduler)``, when given, builds each replica's
+    write-ahead log (e.g. a real fsync-backed ``WriteAheadLog``); the
+    default is the in-memory ``MemWAL`` — no durability cost.
     """
     if sync not in ("wire", "toy"):
         raise ValueError(f"unknown sync mode {sync!r}")
@@ -142,7 +147,7 @@ def start_replicas(
             comm=comm,
             application=app,
             assembler=app,
-            wal=MemWAL([]),
+            wal=make_wal(node_id, rt) if make_wal is not None else MemWAL([]),
             signer=app,
             verifier=app,
             request_inspector=app.inspector,

@@ -132,6 +132,15 @@ class Verifier(abc.ABC):
         """Extract auxiliary data out of a signed message payload."""
         return b""
 
+    def raw_requests_from_proposal(self, proposal: Proposal) -> Sequence[bytes]:
+        """The raw request bytes inside a proposal, for re-admission to the
+        request pool when a pipelined slot is abandoned during crash restore
+        (the slot's requests live nowhere else after a reboot).  Default
+        returns nothing — re-admission is then skipped and the requests are
+        re-submitted by their clients, which is always correct (the pool
+        dedups and delivery removal forgets decided identities)."""
+        return ()
+
     # --- batch entry points (TPU acceleration seam) ---------------------
 
     def verify_requests_batch(self, raw_requests: Sequence[bytes]) -> list[Optional[RequestInfo]]:

@@ -60,6 +60,15 @@ class Configuration:
     # --- lifecycle ------------------------------------------------------
     sync_on_start: bool = False
 
+    # --- decision pipelining (no reference counterpart) -----------------
+    # Bounded window of in-flight proposal slots.  1 keeps the reference's
+    # single-in-flight semantics; >1 lets the leader pre-prepare seq n+1
+    # before decide(n) while commit/delivery stay sequence-ordered.
+    # Pipelining requires a static leader: rotation counts decisions per
+    # leader against checkpoint certificates that a pipelined window does
+    # not produce in order, so depth > 1 demands leader_rotation off.
+    pipeline_depth: int = 1
+
     # --- TPU crypto engine (no reference counterpart) -------------------
     # Minimum number of pending verifications before the engine prefers the
     # TPU path over the CPU fallback, and the micro-batch coalescing window.
@@ -122,6 +131,10 @@ class Configuration:
             errs.append("decisions_per_leader must be positive when rotating")
         if not self.leader_rotation and self.decisions_per_leader != 0:
             errs.append("decisions_per_leader must be zero when rotation is off")
+        if self.pipeline_depth < 1:
+            errs.append("pipeline_depth must be >= 1")
+        if self.pipeline_depth > 1 and self.leader_rotation:
+            errs.append("pipeline_depth > 1 requires leader_rotation off")
         if errs:
             raise ValueError("invalid configuration: " + "; ".join(errs))
 

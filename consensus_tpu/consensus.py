@@ -90,6 +90,8 @@ class Consensus:
             and getattr(wal, "_metrics", None) is None
         ):
             wal.attach_metrics(self.metrics.wal)
+        if hasattr(wal, "attach_consensus_metrics"):
+            wal.attach_consensus_metrics(self.metrics.consensus)
 
         self.nodes: tuple[int, ...] = ()
         self.controller: Optional[Controller] = None
@@ -143,7 +145,37 @@ class Consensus:
         self._create_components()
         # Sequence i was delivered -> we expect proposal i+1 next.
         self._start_components(view, seq + 1, dec)
+        self._readmit_abandoned()
         self._running = True
+
+    def _readmit_abandoned(self) -> None:
+        """Re-admit the requests of pipelined slots the WAL restore abandoned
+        above the oldest undecided sequence.  Those batches were pre-prepared
+        but never commit-signed anywhere (SAFETY.md §5), so the only cost of
+        dropping the slots is losing the requests — unless we hand them back
+        to the pool here.  Dedup/removal in the pool makes this idempotent:
+        a request that was meanwhile decided (or re-submitted) is refused."""
+        abandoned = self.state.take_abandoned()
+        if not abandoned:
+            return
+        raws: list[bytes] = []
+        for proposal in abandoned:
+            try:
+                raws.extend(self.verifier.raw_requests_from_proposal(proposal))
+            except Exception:
+                logger.exception(
+                    "%d: could not unpack an abandoned pipelined proposal; "
+                    "its requests must be re-submitted by clients",
+                    self.config.self_id,
+                )
+        logger.info(
+            "%d: re-admitting %d request(s) from %d abandoned pipelined slot(s)",
+            self.config.self_id, len(raws), len(abandoned),
+        )
+        for raw in raws:
+            self.scheduler.post(
+                lambda raw=raw: self.pool.submit(raw), name="readmit-abandoned"
+            )
 
     def _set_view_and_seq(self, view: int, seq: int, dec: int) -> tuple[int, int, int]:
         """Compute the restore point, honoring trailing ViewChange/NewView
@@ -339,6 +371,8 @@ class Consensus:
             ),
             membership_notifier=self.membership_notifier,
             metrics=self.metrics.view,
+            pipeline_depth=self.config.pipeline_depth,
+            consensus_metrics=self.metrics.consensus,
         )
 
     def _start_components(self, view: int, seq: int, dec: int) -> None:

@@ -52,7 +52,7 @@ class Batcher:
         if self._closed:
             on_batch([])
             return
-        if self._pool.count >= self._max_count:
+        if self._pool.available_count >= self._max_count:
             on_batch(self._take())
             return
         self._pending_cb = on_batch
@@ -65,7 +65,7 @@ class Batcher:
         a full batch is available."""
         if self._pending_cb is None or self._closed:
             return
-        if self._pool.count >= self._max_count:
+        if self._pool.available_count >= self._max_count:
             self._complete()
 
     def _interval_expired(self) -> None:

@@ -275,6 +275,14 @@ class Controller:
         self._leader_token = False
         if self.curr_view is not None:
             self.curr_view.abort()
+        # Abandon pipelined slots above the oldest undecided one: the view
+        # change may only ever adopt the oldest (SAFETY.md §5), and a stale
+        # higher entry would otherwise shadow it after the next decide.
+        # No-op at depth 1 (at most one entry in flight).
+        self.in_flight.drop_above_oldest()
+        # Slots that will never decide must hand their requests back to the
+        # batcher (the new view's leader re-batches them from the pool).
+        self.pool.release_reservations()
         return True
 
     # ------------------------------------------------------------- ingress
@@ -401,6 +409,17 @@ class Controller:
         metadata = self.curr_view.get_metadata()
         proposal = self._assembler.assemble_proposal(metadata, batch)
         self.curr_view.propose(proposal)
+        if self.curr_view.effective_depth > 1:
+            # The batch now rides an in-flight slot while still pooled
+            # (removal only happens at delivery): hide it from the batcher
+            # or the NEXT slot would re-propose the same requests.
+            self.pool.reserve_raws(batch)
+        if self.curr_view.can_propose():
+            # Pipelined window still has slot room: immediately pull the
+            # next batch instead of waiting for decide() to hand the
+            # leader token back (depth 1 never takes this — can_propose
+            # is always False there).
+            self._acquire_leader_token()
 
     # ------------------------------------------------------------- deciding
 
@@ -458,11 +477,20 @@ class Controller:
                 self.checkpoint.set(
                     response.latest.proposal, response.latest.signatures
                 )
+            self._state.prune_decided(latest)
+            # Synced-past slots never hit the per-delivery removal path, so
+            # their reservations would pin pooled requests forever.
+            self.pool.release_reservations()
             return response.reconfig
         begin = self._sched.now()
         reconfig = self._application.deliver(proposal, signatures)
         self.metrics.view.latency_batch_save.observe(self._sched.now() - begin)
         self.checkpoint.set(proposal, signatures)
+        # Forget the delivered slot's mem-window/in-flight entries: with a
+        # pipelined window the view changer must only ever see the OLDEST
+        # undecided slot, and the persist-before-sign coupling check must
+        # not match against an already-delivered entry.
+        self._state.prune_decided(md.latest_sequence)
         return reconfig
 
     def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:

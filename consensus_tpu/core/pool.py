@@ -114,6 +114,10 @@ class RequestPool:
         self._parked: deque[_Parked] = deque()
         # Recently-deleted identities -> deletion time (dedup of stragglers).
         self._deleted: "OrderedDict[str, float]" = OrderedDict()
+        # Identities the leader has batched into a still-in-flight pipelined
+        # proposal: hidden from next_requests until decided (removed) or the
+        # view aborts (released).  Always empty at pipeline_depth=1.
+        self._reserved: set[str] = set()
         self._timers_stopped = False
         self._closed = False
         self._metrics = metrics or MetricsRequestPool(NoopProvider())
@@ -263,7 +267,9 @@ class RequestPool:
         """
         out: list[bytes] = []
         total = 0
-        for entry in self._fifo.values():
+        for key, entry in self._fifo.items():
+            if key in self._reserved:
+                continue  # already riding an in-flight pipelined slot
             if len(out) >= max_count:
                 break
             if out and total + len(entry.raw) > max_size_bytes:
@@ -271,6 +277,24 @@ class RequestPool:
             out.append(entry.raw)
             total += len(entry.raw)
         return out
+
+    def reserve_raws(self, raw_requests: Iterable[bytes]) -> None:
+        """Hide pooled requests from subsequent :meth:`next_requests` while
+        they ride an in-flight pipelined proposal.  Without this a depth>1
+        leader would re-batch the pool front into the next slot (removal
+        only happens at delivery) and decide every request twice."""
+        for raw in raw_requests:
+            try:
+                key = self._inspector.request_id(raw).key()
+            except Exception:
+                continue  # unidentifiable requests were never pooled
+            if key in self._fifo:
+                self._reserved.add(key)
+
+    def release_reservations(self) -> None:
+        """Forget all reservations (view abort/sync): slots that will never
+        decide must hand their requests back to the batcher."""
+        self._reserved.clear()
 
     def remove_request(self, info: RequestInfo) -> bool:
         """Remove a delivered/invalid request.  Returns whether it was here.
@@ -313,6 +337,7 @@ class RequestPool:
         return present
 
     def _delete_entry(self, key: str) -> bool:
+        self._reserved.discard(key)
         entry = self._fifo.pop(key, None)
         if entry is None:
             return False
@@ -384,6 +409,13 @@ class RequestPool:
     @property
     def count(self) -> int:
         return len(self._fifo)
+
+    @property
+    def available_count(self) -> int:
+        """Pooled requests NOT riding an in-flight pipelined slot — what
+        :meth:`next_requests` can actually hand out.  Equals :attr:`count`
+        at pipeline_depth=1 (reservations never happen there)."""
+        return len(self._fifo) - len(self._reserved)
 
     @property
     def size_bytes(self) -> int:

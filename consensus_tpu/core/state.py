@@ -49,38 +49,81 @@ def restore_requests_best_effort(view: "View", proposal: Proposal) -> None:
 
 
 class InFlightData:
-    """Holder of the proposal currently moving through the 3-phase pipeline,
-    plus whether we got it to the PREPARED stage.
+    """Holder of the proposals currently moving through the 3-phase
+    pipeline, plus whether each reached the PREPARED stage.
 
     Parity: reference internal/bft/util.go:191-254 (lock dropped — the
-    runtime is single-threaded per replica).
+    runtime is single-threaded per replica), WINDOWED for decision
+    pipelining: one entry per in-flight sequence.  ``proposal()`` /
+    ``is_prepared()`` report the OLDEST undecided entry — the only slot a
+    view change can ever need to adopt (nothing above the oldest can have
+    been commit-signed anywhere; see SAFETY.md §5) — so the view changer's
+    single-slot reading stays correct at any depth.  Decided sequences are
+    dropped by ``prune_decided`` (the controller calls it on delivery).
     """
 
     def __init__(self) -> None:
-        self._proposal: Optional[Proposal] = None
-        self._prepared = False
+        #: seq (or None when the proposal carries no decodable metadata,
+        #: which sorts as the oldest) -> [proposal, prepared].
+        self._slots: dict[Optional[int], list] = {}
+
+    @staticmethod
+    def _seq_of(proposal: Proposal) -> Optional[int]:
+        if not proposal.metadata:
+            return None
+        try:
+            return decode_view_metadata(proposal.metadata).latest_sequence
+        except Exception:
+            return None
+
+    def _oldest(self) -> Optional[list]:
+        if not self._slots:
+            return None
+        key = min(self._slots, key=lambda k: -1 if k is None else k)
+        return self._slots[key]
 
     def proposal(self) -> Optional[Proposal]:
-        return self._proposal
+        slot = self._oldest()
+        return slot[0] if slot is not None else None
 
     def is_prepared(self) -> bool:
-        return self._prepared
+        slot = self._oldest()
+        return bool(slot[1]) if slot is not None else False
 
     def store_proposal(self, proposal: Proposal) -> None:
-        self._proposal = proposal
-        self._prepared = False
+        self._slots[self._seq_of(proposal)] = [proposal, False]
 
-    def store_prepared(self, view: int, seq: int) -> None:
-        prop = self._proposal
-        if prop is None:
+    def store_prepared(self, view: int, seq: int) -> bool:
+        """Mark the entry whose metadata stamps match ``(view, seq)`` as
+        prepared; returns whether one matched."""
+        for slot in self._slots.values():
+            prop = slot[0]
+            md = (
+                decode_view_metadata(prop.metadata)
+                if prop.metadata
+                else ViewMetadata()
+            )
+            if md.view_id == view and md.latest_sequence == seq:
+                slot[1] = True
+                return True
+        return False
+
+    def prune_decided(self, seq: int) -> None:
+        """Drop every entry at or below a delivered sequence."""
+        for key in [k for k in self._slots if k is not None and k <= seq]:
+            del self._slots[key]
+
+    def drop_above_oldest(self) -> None:
+        """Abandon pipelined entries above the oldest undecided one (view
+        aborts: higher slots are re-proposed in the next view, and our
+        attestation must only ever cover the contested oldest slot)."""
+        if len(self._slots) <= 1:
             return
-        md = decode_view_metadata(prop.metadata) if prop.metadata else ViewMetadata()
-        if md.view_id == view and md.latest_sequence == seq:
-            self._prepared = True
+        keep = min(self._slots, key=lambda k: -1 if k is None else k)
+        self._slots = {keep: self._slots[keep]}
 
     def clear(self) -> None:
-        self._proposal = None
-        self._prepared = False
+        self._slots.clear()
 
 
 class PersistedState:
@@ -100,31 +143,62 @@ class PersistedState:
         #: Raw WAL entries read at boot (the restore source).
         self.entries = list(entries)
         #: In-memory WAL tail for MID-RUN view restarts (see
-        #: reseed_if_inflight_matches): the latest persisted pre-prepare
-        #: and, if one followed it, our commit for it.
-        self._mem_proposed: Optional[ProposedRecord] = None
-        self._mem_commit: Optional[SavedCommit] = None
+        #: reseed_if_inflight_matches), WINDOWED for decision pipelining:
+        #: seq -> [ProposedRecord, Optional[SavedCommit]] for every sequence
+        #: in the trailing run of protocol records.  At pipeline depth 1
+        #: this holds at most the single legacy mem-tail pair.
+        self._mem_window: dict[int, list] = {}
         #: The record object most recently appended this run — the guard for
         #: the verified-upgrade append (it must only ever replace the tail).
         self._last_written: Optional[SavedMessage] = None
+        #: Proposals restore() abandoned above the oldest in-flight slot —
+        #: the consensus layer re-admits their requests to the pool.
+        self.abandoned: list[Proposal] = []
         try:
+            for rec in self._trailing_protocol_records():
+                if isinstance(rec, ProposedRecord):
+                    # A later record at the same seq is the verified-upgrade
+                    # twin; forward replay makes it win, like the legacy tail.
+                    self._mem_window[rec.pre_prepare.seq] = [rec, None]
+                else:  # SavedCommit
+                    slot = self._mem_window.get(rec.commit.seq)
+                    if (
+                        slot is not None
+                        and slot[0].pre_prepare.view == rec.commit.view
+                    ):
+                        slot[1] = rec
             last = self._last_record()
-            if isinstance(last, SavedCommit) and len(self.entries) >= 2:
-                prev = decode_saved(self.entries[-2])
-                if isinstance(prev, ProposedRecord):
-                    self._mem_proposed, self._mem_commit = prev, last
-            elif isinstance(last, ProposedRecord):
-                self._mem_proposed = last
-                # The restored tail counts as "last written" so a restore-
-                # time re-verification success upgrades the on-disk record
-                # too — without this, only the FIRST crash is protected and
-                # a second crash re-runs the spurious re-verify.
-                self._last_written = last
+            if isinstance(last, ProposedRecord):
+                slot = self._mem_window.get(last.pre_prepare.seq)
+                if slot is not None:
+                    # The restored tail counts as "last written" so a
+                    # restore-time re-verification success upgrades the
+                    # on-disk record too — without this, only the FIRST
+                    # crash is protected and a second crash re-runs the
+                    # spurious re-verify.  Keep object identity between the
+                    # window slot and the tail guard.
+                    self._last_written = slot[0]
         except Exception:
             # A torn/corrupt tail must not fail boot here: restore() has
             # its own tolerant handling ("starting clean"), and with no
             # mem-tail the reseed guard simply never fires.
             logger.exception("WAL mem-tail seeding failed; reseed disabled")
+
+    def _trailing_protocol_records(self) -> list:
+        """The contiguous run of ProposedRecord/SavedCommit entries at the
+        WAL tail, in log order.  A run never spans views: any view install
+        appends a SavedNewView (and the endorsement tail sits above its
+        SavedViewChange), both of which stop the backward scan."""
+        tail: list = []
+        idx = len(self.entries) - 1
+        while idx >= 0:
+            rec = decode_saved(self.entries[idx])
+            if not isinstance(rec, (ProposedRecord, SavedCommit)):
+                break
+            tail.append(rec)
+            idx -= 1
+        tail.reverse()
+        return tail
 
     # --- saving ------------------------------------------------------------
 
@@ -165,12 +239,14 @@ class PersistedState:
             plan.crash(point + ".pre")
         if isinstance(record, ProposedRecord):
             self._in_flight.store_proposal(record.pre_prepare.proposal)
-            self._mem_proposed, self._mem_commit = record, None
+            self._mem_window[record.pre_prepare.seq] = [record, None]
         elif isinstance(record, SavedCommit):
-            self._in_flight.store_prepared(record.commit.view, record.commit.seq)
-            if not self._in_flight.is_prepared():
+            matched = self._in_flight.store_prepared(
+                record.commit.view, record.commit.seq
+            )
+            if not matched:
                 # Coupling invariant: a commit record is only ever persisted
-                # for the proposal currently in flight (the commit signature
+                # for a proposal currently in flight (the commit signature
                 # was minted against it).  If the (view, seq) stamps do not
                 # line up, the check_in_flight "unprepared attestations are
                 # no-argument" relaxation would be silently decoupled from
@@ -178,9 +254,14 @@ class PersistedState:
                 raise RuntimeError(
                     "persist-before-sign coupling violated: commit record at "
                     f"(view={record.commit.view}, seq={record.commit.seq}) "
-                    "does not match the in-flight proposal"
+                    "does not match an in-flight proposal"
                 )
-            self._mem_commit = record
+            slot = self._mem_window.get(record.commit.seq)
+            if (
+                slot is not None
+                and slot[0].pre_prepare.view == record.commit.view
+            ):
+                slot[1] = record
         self._last_written = record
         self._wal.append(
             encode_saved(record),
@@ -222,12 +303,14 @@ class PersistedState:
         regression (seed-3428 chaos wedge: two restored replicas idling at
         view 1 while holding (view 8) proposal records).
 
-        Reads the mem-tail ``__init__`` already seeded (same two tail
-        cases, and behind its torn-tail exception guard — a corrupt tail
-        must not fail boot)."""
-        rec = self._mem_proposed
-        if rec is None:
+        Reads the mem-window ``__init__`` already seeded (same tail cases,
+        and behind its torn-tail exception guard — a corrupt tail must not
+        fail boot).  The NEWEST (max-seq) entry is the legacy "last
+        ProposedRecord" — and since a trailing run never spans views, every
+        window entry proves the same installed view anyway."""
+        if not self._mem_window:
             return None
+        rec = self._mem_window[max(self._mem_window)][0]
         pp = rec.pre_prepare
         dec = 0
         if pp.proposal.metadata:
@@ -272,17 +355,85 @@ class PersistedState:
     def restore(self, view: View) -> None:
         """Re-enter the phase the replica crashed in: PROPOSED if the last
         record is a proposal, PREPARED if it is our commit (with our own
-        signature resurrected)."""
+        signature resurrected).
+
+        With decision pipelining the WAL tail can hold records from SEVERAL
+        sequences.  Only the oldest undecided slot (``view.proposal_sequence``,
+        anchored by the application's delivered height) is re-entered; every
+        proposal above it is ABANDONED into :attr:`abandoned` for pool
+        re-admission — by the in-order commit rule nothing above the oldest
+        can have been commit-signed anywhere (SAFETY.md §5), so dropping
+        those slots cannot contradict any commit quorum.  A single-sequence
+        tail takes the exact legacy path."""
         view.phase = Phase.COMMITTED
         last = self._last_record()
         if last is None:
             logger.info("nothing to restore")
+            return
+        tail = self._trailing_protocol_records()
+        seqs = {
+            r.pre_prepare.seq if isinstance(r, ProposedRecord) else r.commit.seq
+            for r in tail
+        }
+        if len(seqs) > 1:
+            self._restore_windowed(view)
             return
         if isinstance(last, ProposedRecord):
             self._recover_proposed(last, view)
         elif isinstance(last, SavedCommit):
             self._recover_prepared(last, view)
         # SavedNewView / SavedViewChange need no phase recovery.
+
+    def _restore_windowed(self, view: View) -> None:
+        """Multi-sequence (pipelined) tail restore.  ``_mem_window`` was
+        seeded from the same trailing run; the target slot is the oldest
+        undecided sequence the caller booted the view at."""
+        target = view.proposal_sequence
+        for seq, slot in self._mem_window.items():
+            if slot[1] is not None and seq > target:
+                # A commit of ours above the delivered height would mean
+                # the in-order gate was breached (or the app state is
+                # behind a WAL from someone else's future) — refuse to
+                # guess, like the legacy "WAL seq ahead" path.
+                raise ValueError(
+                    f"WAL commit at seq {seq} is ahead of our last "
+                    f"committed {target}"
+                )
+        slot = self._mem_window.get(target)
+        if slot is not None:
+            rec, commit = slot
+            pp = rec.pre_prepare
+            view.number = pp.view
+            if commit is not None:
+                self._enter_prepared(rec, commit.commit, view)
+                logger.info(
+                    "restored into PREPARED at seq %d (pipelined tail)", pp.seq
+                )
+            else:
+                self._enter_proposed(rec, view)
+                logger.info(
+                    "restored into PROPOSED at seq %d (pipelined tail)", pp.seq
+                )
+        dropped = sorted(s for s in self._mem_window if s > target)
+        for seq in dropped:
+            self.abandoned.append(self._mem_window[seq][0].pre_prepare.proposal)
+        if dropped:
+            logger.info(
+                "abandoned %d pipelined slot(s) above seq %d: %s",
+                len(dropped), target, dropped,
+            )
+
+    def take_abandoned(self) -> list[Proposal]:
+        """Drain the proposals restore() abandoned above the oldest slot."""
+        out, self.abandoned = self.abandoned, []
+        return out
+
+    def prune_decided(self, seq: int) -> None:
+        """Forget mem-window and in-flight entries at or below a delivered
+        sequence (the controller calls this on every delivery)."""
+        for key in [k for k in self._mem_window if k <= seq]:
+            del self._mem_window[key]
+        self._in_flight.prune_decided(seq)
 
     def _recover_proposed(self, record: ProposedRecord, view: View) -> None:
         pp = record.pre_prepare
@@ -316,7 +467,8 @@ class PersistedState:
         leader's critical path per decision (ADVICE r4).  Losing an
         unflushed upgrade in a crash just re-verifies: the documented
         best-effort behavior."""
-        rec = self._mem_proposed
+        slot = self._mem_window.get(seq)
+        rec = slot[0] if slot is not None else None
         if (
             rec is not None
             and not rec.verified
@@ -324,7 +476,7 @@ class PersistedState:
             and rec.pre_prepare.seq == seq
         ):
             upgraded = dataclasses.replace(rec, verified=True)
-            self._mem_proposed = upgraded
+            slot[0] = upgraded
             if self._last_written is rec:
                 try:
                     self._wal.append(encode_saved(upgraded), truncate_to=False)
@@ -435,13 +587,13 @@ class PersistedState:
         signers).  Restarts at a different view or sequence are untouched:
         cross-view safety belongs to the view-change protocol
         (check_in_flight + the embedded re-commit view)."""
-        rec = self._mem_proposed
-        if rec is None:
+        slot = self._mem_window.get(view.proposal_sequence)
+        if slot is None:
             return
+        rec, commit = slot
         pp = rec.pre_prepare
         if pp.view != view.number or pp.seq != view.proposal_sequence:
             return
-        commit = self._mem_commit
         if commit is not None and (
             commit.commit.view != pp.view or commit.commit.seq != pp.seq
         ):

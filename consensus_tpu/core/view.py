@@ -29,7 +29,7 @@ from enum import IntEnum
 from typing import Callable, Optional, Protocol, Sequence
 
 from consensus_tpu.api.deps import MembershipNotifier, Signer, Verifier
-from consensus_tpu.metrics import MetricsView, NoopProvider
+from consensus_tpu.metrics import MetricsConsensus, MetricsView, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler
 from consensus_tpu.types import Proposal, RequestInfo, Signature
 from consensus_tpu.utils.digests import commit_signatures_digest
@@ -96,7 +96,10 @@ class ViewComm(Protocol):
 
 
 class ViewState(Protocol):
-    """WAL persistence seam (PersistedState implements it)."""
+    """WAL persistence seam (PersistedState implements it).  ``save`` also
+    accepts a ``truncate`` keyword (pipelined future-slot records pass
+    ``truncate=False`` so only the oldest slot marks restore points); it is
+    omitted here so depth-1 fakes need not accept it."""
 
     def save(self, record, on_durable=None) -> None: ...
 
@@ -105,6 +108,33 @@ class ViewState(Protocol):
 
 class CheckpointReader(Protocol):
     def get(self) -> tuple[Proposal, tuple[Signature, ...]]: ...
+
+
+class _FutureSlot:
+    """Per-sequence state for one in-flight slot ABOVE the oldest undecided
+    sequence (pipeline_depth > 1 only).  A future slot runs pre-prepare and
+    prepare — verify the proposal, persist the ProposedRecord, broadcast our
+    Prepare, collect peers' votes — but NEVER signs a commit: the in-order
+    commit gate lives in the promotion path (_start_next_seq), which folds
+    the slot into the View's legacy current-sequence fields only after every
+    lower sequence has decided."""
+
+    __slots__ = (
+        "pre_prepare", "proposal", "requests", "prepares", "commits",
+        "prepare_sent", "processed", "valid_commit_sigs", "rejected", "begin",
+    )
+
+    def __init__(self) -> None:
+        self.pre_prepare: Optional[tuple[int, PrePrepare]] = None
+        self.proposal: Optional[Proposal] = None
+        self.requests: Sequence[RequestInfo] = ()
+        self.prepares: dict[int, Prepare] = {}
+        self.commits: dict[int, Commit] = {}
+        self.prepare_sent: Optional[Prepare] = None
+        self.processed = False
+        self.valid_commit_sigs: dict[int, Signature] = {}
+        self.rejected: set[int] = set()
+        self.begin = 0.0
 
 
 class View:
@@ -133,6 +163,8 @@ class View:
         membership_notifier: Optional[MembershipNotifier] = None,
         blacklist_supported: bool = False,
         metrics: Optional[MetricsView] = None,
+        pipeline_depth: int = 1,
+        consensus_metrics: Optional[MetricsConsensus] = None,
     ) -> None:
         self._sched = scheduler
         self.self_id = self_id
@@ -159,6 +191,15 @@ class View:
         self.in_flight_proposal: Optional[Proposal] = None
         self.in_flight_requests: Sequence[RequestInfo] = ()
         self.my_commit_signature: Optional[Signature] = None
+
+        #: Bounded in-flight window (config `pipeline_depth`).  The legacy
+        #: single-slot fields below always describe the OLDEST undecided
+        #: sequence; sequences strictly above it (up to the window edge) live
+        #: in `_future` and only ever reach the prepare phase there — the
+        #: commit gate is promotion-ordered (see _FutureSlot).
+        self.pipeline_depth = max(1, pipeline_depth)
+        self._future: dict[int, _FutureSlot] = {}
+        self._consensus_metrics = consensus_metrics
 
         # Pipelining buffers: current sequence + the next one (depth 1),
         # parity: reference view.go:107-113,860-894.
@@ -224,17 +265,58 @@ class View:
                 dataclasses.replace(self._curr_commit_sent, assist=False)
             )
 
+    @property
+    def effective_depth(self) -> int:
+        """Window width actually in force.  Rotation counts decisions per
+        leader against checkpoint certificates a pipelined window does not
+        produce in order, so depth collapses to 1 under rotation (config
+        validation rejects the combination outright)."""
+        return self.pipeline_depth if self.decisions_per_leader == 0 else 1
+
+    @property
+    def next_propose_seq(self) -> int:
+        """First sequence in the window with no accepted or pending
+        proposal — the slot the leader's next pre-prepare targets."""
+        if (
+            self.phase == Phase.COMMITTED
+            and self._pending_pre_prepare is None
+            and self.in_flight_proposal is None
+        ):
+            return self.proposal_sequence
+        s = self.proposal_sequence + 1
+        while True:
+            slot = self._future.get(s)
+            if slot is None or slot.pre_prepare is None:
+                return s
+            s += 1
+
+    def can_propose(self) -> bool:
+        """Whether the leader still has window room for another proposal
+        (always False at depth 1: the controller's decide-driven token flow
+        already covers the single-slot cadence)."""
+        if self.stopped or self.effective_depth <= 1:
+            return False
+        return self.next_propose_seq < self.proposal_sequence + self.effective_depth
+
     def propose(self, proposal: Proposal) -> None:
         """Leader entry point: wrap ``proposal`` in a PrePrepare carrying the
         previous decision's commit signatures, and pre-prepare *ourselves*
         first (the broadcast to others happens after we persist — parity:
-        reference view.go:951-974, 421-423)."""
+        reference view.go:951-974, 421-423).
+
+        With a pipelined window the pre-prepare targets the first free slot,
+        and carries NO previous-decision signatures: a follower verifying a
+        future slot has not delivered the preceding decisions yet, so its
+        checkpoint cannot match whatever certificate the leader would attach
+        (pipelining requires rotation off, where the certificate is unused
+        and `_verify_prev_commit_signatures` accepts an empty set)."""
+        pipelined = self.effective_depth > 1
         _, prev_sigs = self._checkpoint.get()
         pp = PrePrepare(
             view=self.number,
-            seq=self.proposal_sequence,
+            seq=self.next_propose_seq if pipelined else self.proposal_sequence,
             proposal=proposal,
-            prev_commit_signatures=tuple(prev_sigs),
+            prev_commit_signatures=() if pipelined else tuple(prev_sigs),
         )
         self.handle_message(self.leader_id, pp)
 
@@ -282,6 +364,20 @@ class View:
             self._handle_prev_seq_message(sender, msg)
             return
 
+        depth = self.effective_depth
+        if depth > 1 and self.proposal_sequence < msg_seq <= self.proposal_sequence + depth:
+            # Windowed mode: anything above the oldest slot (up to one past
+            # the window edge, for a leader one decision ahead of us) lands
+            # in a future slot.  Depth 1 keeps the legacy ps/ps+1 routing
+            # below untouched.
+            self._handle_future_slot_message(sender, msg, msg_seq)
+            return
+        if depth > 1 and msg_seq < self.proposal_sequence - 1:
+            # Replicas spread over several sequences routinely deliver
+            # assist votes for slots the window has already decided and
+            # advanced past — stale by construction, not sync evidence.
+            return
+
         if msg_seq not in (self.proposal_sequence, self.proposal_sequence + 1):
             logger.warning(
                 "%d: got %s from %d at seq %d, ours is %d",
@@ -323,6 +419,135 @@ class View:
         if self._pending_pre_prepare is None:
             self._pending_pre_prepare = (sender, pp)
             self._advance()
+
+    # ------------------------------------------- pipelined window (depth > 1)
+
+    def _handle_future_slot_message(
+        self, sender: int, msg: ConsensusMessage, seq: int
+    ) -> None:
+        """Buffer/process a message for a sequence above the oldest slot.
+
+        Sequences strictly inside the window run pre-prepare/prepare
+        immediately; the slot one past the window edge is buffer-only until
+        a decision slides the window over it."""
+        slot = self._future.get(seq)
+        if slot is None:
+            slot = self._future[seq] = _FutureSlot()
+        if isinstance(msg, PrePrepare):
+            if sender != self.leader_id:
+                logger.warning(
+                    "%d: pre-prepare from %d but leader is %d",
+                    self.self_id, sender, self.leader_id,
+                )
+                return
+            if slot.pre_prepare is None:
+                slot.pre_prepare = (sender, msg)
+                if seq < self.proposal_sequence + self.effective_depth:
+                    self._process_future_slot(seq, slot)
+            return
+        if sender == self.self_id:
+            return  # own votes are implicit
+        if isinstance(msg, Prepare):
+            slot.prepares.setdefault(sender, msg)
+        else:  # Commit
+            if msg.signature.id != sender:
+                return  # vote must be signed by its sender
+            slot.commits.setdefault(sender, msg)
+
+    def _process_future_slot(self, seq: int, slot: _FutureSlot) -> None:
+        """Run pre-prepare + prepare for a future slot: verify, persist the
+        ProposedRecord (truncate-free — only the oldest slot marks a stable
+        restore point), and broadcast our Prepare once durable AND verified.
+        Mirrors _try_process_proposal but never advances the legacy phase
+        machine — commits stay gated on promotion."""
+        assert slot.pre_prepare is not None
+        _, pp = slot.pre_prepare
+        proposal = pp.proposal
+        i_am_leader = self.self_id == self.leader_id
+        prepare = Prepare(view=self.number, seq=seq, digest=proposal.digest())
+        gate = {"durable": False, "verified": False, "prepare_sent": False}
+
+        def maybe_send_prepare() -> None:
+            if not (gate["durable"] and gate["verified"]) or gate["prepare_sent"]:
+                return
+            gate["prepare_sent"] = True
+            if self.stopped:
+                return  # aborted view: never utter stale-view votes
+            assist_copy = Prepare(
+                view=prepare.view, seq=prepare.seq, digest=prepare.digest, assist=True
+            )
+            # A late flush may land after this slot was promoted (it became
+            # the current sequence) or even decided; park the assist copy
+            # wherever the retransmission machinery now looks for it.
+            if self.proposal_sequence == seq and self._curr_prepare_sent is None:
+                self._curr_prepare_sent = assist_copy
+            elif self.proposal_sequence == seq + 1 and self._prev_prepare_sent is None:
+                self._prev_prepare_sent = assist_copy
+            else:
+                slot.prepare_sent = assist_copy
+            self._comm.broadcast(prepare)
+
+        def send_after_durable() -> None:
+            if gate["durable"]:
+                return
+            gate["durable"] = True
+            if self.stopped:
+                return
+            if i_am_leader:
+                # Reveal-before-verify, same rationale as the oldest slot.
+                self._comm.broadcast(pp)
+            maybe_send_prepare()
+
+        if i_am_leader:
+            self._state.save(
+                ProposedRecord(pre_prepare=pp, prepare=prepare, verified=False),
+                on_durable=send_after_durable,
+                truncate=False,
+            )
+        try:
+            requests = self._verify_proposal(
+                proposal,
+                pp.prev_commit_signatures,
+                expected_seq=seq,
+                expected_decisions=self.decisions_in_view
+                + (seq - self.proposal_sequence),
+            )
+        except Exception as err:
+            logger.warning(
+                "%d: bad pipelined proposal from leader %d at seq %d: %s",
+                self.self_id, self.leader_id, seq, err,
+            )
+            self._failure_detector.complain(self.number, False)
+            self._sync.sync()
+            self.abort()
+            return
+
+        slot.proposal = proposal
+        slot.requests = tuple(requests)
+        slot.processed = True
+        slot.begin = self._sched.now()
+        if i_am_leader:
+            self._state.mark_proposed_verified(self.number, seq)
+        else:
+            self._state.save(
+                ProposedRecord(pre_prepare=pp, prepare=prepare),
+                on_durable=send_after_durable,
+                truncate=False,
+            )
+        gate["verified"] = True
+        maybe_send_prepare()
+        self._update_inflight_depth()
+        logger.info(
+            "%d: pipelined seq %d in view %d (oldest %d)",
+            self.self_id, seq, self.number, self.proposal_sequence,
+        )
+
+    def _update_inflight_depth(self) -> None:
+        if self._consensus_metrics is None:
+            return
+        depth = 1 if self.phase in (Phase.PROPOSED, Phase.PREPARED) else 0
+        depth += sum(1 for slot in self._future.values() if slot.processed)
+        self._consensus_metrics.in_flight_depth.set(depth)
 
     # ------------------------------------------------------ phase machine
 
@@ -479,6 +704,7 @@ class View:
             )
         gate["verified"] = True
         maybe_send_prepare()
+        self._update_inflight_depth()
         logger.info("%d: proposed seq %d in view %d", self.self_id, prepare.seq, self.number)
 
     # --- PROPOSED -> PREPARED (view.go:441-517) ----------------------------
@@ -586,10 +812,7 @@ class View:
             return  # not enough to possibly decide; keep buffering
 
         sigs = [c.signature for c in pending]
-        self.metrics.count_batch_sig_verifications.add(len(sigs))
-        results = self._verifier.verify_consenter_sigs_batch(
-            sigs, self.in_flight_proposal
-        )
+        results = self._verify_commits_coalesced(sigs, pending)
         for commit, result in zip(pending, results):
             if result is None:
                 logger.warning(
@@ -599,6 +822,63 @@ class View:
                 self._rejected_commit_senders.add(commit.signature.id)
             else:
                 self._valid_commit_sigs[commit.signature.id] = commit.signature
+
+    def _verify_commits_coalesced(
+        self, sigs: list[Signature], pending: list[Commit]
+    ) -> Sequence[Optional[bytes]]:
+        """One verification launch for the oldest slot's pending commits —
+        and, when pipelined, for every future slot's buffered commits too.
+        Peers that decided ahead of us send their commit for seq n+k the
+        moment it is THEIR oldest, so under a saturated window the votes a
+        promoted slot will need are already verified by the time it signs:
+        launches-per-decision drops below one.  Results for future slots are
+        cached on the slot (valid_commit_sigs / rejected)."""
+        cm = self._consensus_metrics
+        future_groups: list[tuple[_FutureSlot, list[Commit]]] = []
+        if self.effective_depth > 1:
+            for s in sorted(self._future):
+                slot = self._future[s]
+                if not slot.processed or slot.proposal is None:
+                    continue
+                want = slot.proposal.digest()
+                extra = [
+                    c
+                    for sender, c in slot.commits.items()
+                    if sender not in slot.valid_commit_sigs
+                    and sender not in slot.rejected
+                    and c.digest == want
+                ]
+                if extra:
+                    future_groups.append((slot, extra))
+
+        multi = getattr(self._verifier, "verify_consenter_sigs_multi_batch", None)
+        if not future_groups or multi is None:
+            self.metrics.count_batch_sig_verifications.add(len(sigs))
+            if cm is not None:
+                cm.count_verify_launches.add(1)
+                cm.cross_slot_verify_batch.observe(len(sigs))
+            return self._verifier.verify_consenter_sigs_batch(
+                sigs, self.in_flight_proposal
+            )
+
+        groups = [(self.in_flight_proposal, sigs)]
+        groups.extend(
+            (slot.proposal, [c.signature for c in extra])
+            for slot, extra in future_groups
+        )
+        total = sum(len(g[1]) for g in groups)
+        self.metrics.count_batch_sig_verifications.add(total)
+        if cm is not None:
+            cm.count_verify_launches.add(1)
+            cm.cross_slot_verify_batch.observe(total)
+        all_results = multi(groups)
+        for (slot, extra), slot_results in zip(future_groups, all_results[1:]):
+            for commit, result in zip(extra, slot_results):
+                if result is None:
+                    slot.rejected.add(commit.signature.id)
+                else:
+                    slot.valid_commit_sigs[commit.signature.id] = commit.signature
+        return all_results[0]
 
     # --- sequence pipelining (view.go:851-894) -----------------------------
 
@@ -627,27 +907,90 @@ class View:
         self._valid_commit_sigs = {}
         self._rejected_commit_senders = set()
 
+        kick = False
+        if self.effective_depth > 1:
+            kick = self._promote_future_slot()
+
         # Continue with any buffered next-sequence traffic on a fresh stack.
-        if self._pending_pre_prepare is not None or self._prepares or self._commits:
+        if (
+            kick
+            or self._pending_pre_prepare is not None
+            or self._prepares
+            or self._commits
+        ):
             self._sched.post(self._advance, name=f"view-{self.number}-advance")
+
+    def _promote_future_slot(self) -> bool:
+        """Fold the future slot at the (just advanced) oldest sequence into
+        the legacy current-slot fields.  This is the in-order commit gate:
+        only here — strictly after every lower sequence decided, and on the
+        scheduler event AFTER the prior decision was delivered — does a
+        pipelined slot become eligible to sign and persist a Commit.
+        Returns whether _advance should be (re)posted."""
+        slot = self._future.pop(self.proposal_sequence, None)
+        kick = False
+        if slot is not None:
+            if slot.processed:
+                # Pre-prepare/prepare already ran in the future slot: seed
+                # the current-slot state directly and let _advance drive
+                # PROPOSED -> PREPARED -> decide on the collected votes.
+                self.in_flight_proposal = slot.proposal
+                self.in_flight_requests = slot.requests
+                self.metrics.count_txs_in_batch.set(len(slot.requests))
+                self._begin_pre_prepare = slot.begin or self._sched.now()
+                self.phase = Phase.PROPOSED
+                self.metrics.phase.set(int(self.phase))
+                self._curr_prepare_sent = slot.prepare_sent
+                self._valid_commit_sigs = slot.valid_commit_sigs
+                self._rejected_commit_senders = slot.rejected
+                kick = True
+            elif slot.pre_prepare is not None:
+                self._pending_pre_prepare = slot.pre_prepare
+            self._prepares = slot.prepares
+            self._commits = slot.commits
+        # The window slid: the previously buffer-only edge slot may now be
+        # inside processing range with a parked pre-prepare.
+        edge = self.proposal_sequence + self.effective_depth - 1
+        edge_slot = self._future.get(edge)
+        if (
+            edge_slot is not None
+            and edge_slot.pre_prepare is not None
+            and not edge_slot.processed
+        ):
+            self._process_future_slot(edge, edge_slot)
+        self._update_inflight_depth()
+        return kick
 
     # --- verification (view.go:553-716) ------------------------------------
 
     def _verify_proposal(
-        self, proposal: Proposal, prev_commits: Sequence[Signature]
+        self,
+        proposal: Proposal,
+        prev_commits: Sequence[Signature],
+        *,
+        expected_seq: Optional[int] = None,
+        expected_decisions: Optional[int] = None,
     ) -> Sequence[RequestInfo]:
+        """Verify a proposal against this view.  ``expected_seq`` /
+        ``expected_decisions`` default to the oldest slot's position; future
+        slots pass their own (the decisions offset is seq-relative: both
+        counters advance together on every decide)."""
+        if expected_seq is None:
+            expected_seq = self.proposal_sequence
+        if expected_decisions is None:
+            expected_decisions = self.decisions_in_view
         requests = self._verifier.verify_proposal(proposal)
 
         md = decode_view_metadata(proposal.metadata)
         if md.view_id != self.number:
             raise ValueError(f"metadata view {md.view_id} != {self.number}")
-        if md.latest_sequence != self.proposal_sequence:
+        if md.latest_sequence != expected_seq:
             raise ValueError(
-                f"metadata seq {md.latest_sequence} != {self.proposal_sequence}"
+                f"metadata seq {md.latest_sequence} != {expected_seq}"
             )
-        if md.decisions_in_view != self.decisions_in_view:
+        if md.decisions_in_view != expected_decisions:
             raise ValueError(
-                f"metadata decisions-in-view {md.decisions_in_view} != {self.decisions_in_view}"
+                f"metadata decisions-in-view {md.decisions_in_view} != {expected_decisions}"
             )
         expected_vseq = self._verifier.verification_sequence()
         if proposal.verification_sequence != expected_vseq:
@@ -819,6 +1162,21 @@ class View:
             if self.decisions_per_leader > 0
             else b""
         )
+        if self.effective_depth > 1:
+            # Pipelined: stamp the slot this proposal will actually occupy.
+            # The decisions offset is seq-relative (both counters advance
+            # together on every decide), so followers verifying the future
+            # slot recompute the same number.
+            target = self.next_propose_seq
+            md = ViewMetadata(
+                view_id=self.number,
+                latest_sequence=target,
+                decisions_in_view=self.decisions_in_view
+                + (target - self.proposal_sequence),
+                black_list=black_list,
+                prev_commit_signature_digest=prev_digest,
+            )
+            return encode_view_metadata(md)
         md = ViewMetadata(
             view_id=self.number,
             latest_sequence=self.proposal_sequence,

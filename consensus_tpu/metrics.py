@@ -236,7 +236,8 @@ class MetricsBlacklist(_Bundle):
 
 
 class MetricsConsensus(_Bundle):
-    """Parity: reference pkg/api/metrics.go:319-344 (2 instruments)."""
+    """Parity: reference pkg/api/metrics.go:319-344 (2 instruments), plus
+    the decision-pipelining instruments (consensus_tpu addition)."""
 
     def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
         ln = extend_label_names((), label_names)
@@ -245,6 +246,29 @@ class MetricsConsensus(_Bundle):
         )
         self.latency_sync = p.new_histogram(
             "consensus_latency_sync", "Duration of synchronization rounds.", ln
+        )
+        # --- decision pipelining (pipeline_depth > 1) -------------------
+        self.in_flight_depth = p.new_gauge(
+            "consensus_in_flight_depth",
+            "Proposal slots currently moving through the 3-phase pipeline.",
+            ln,
+        )
+        self.count_verify_launches = p.new_counter(
+            "consensus_verify_launches",
+            "Batched commit-signature verification launches (cross-slot "
+            "coalescing makes this grow slower than decisions).",
+            ln,
+        )
+        self.cross_slot_verify_batch = p.new_histogram(
+            "consensus_cross_slot_verify_batch",
+            "Commit signatures drained per batched verify launch.",
+            ln,
+        )
+        self.wal_records_per_fsync = p.new_gauge(
+            "consensus_wal_records_per_fsync",
+            "Group-commit coalescing ratio: WAL records made durable per "
+            "fsync in the most recent flush window.",
+            ln,
         )
 
 

@@ -91,11 +91,15 @@ class MemWAL(WriteAheadLog):
 
     def __init__(self, backing: list[bytes]) -> None:
         self._backing = backing
+        #: Simulated fsyncs — per append here (no group window), so the
+        #: pipelining coalescing guards can count them like the real WAL's.
+        self.fsync_count = 0
 
     def append(self, entry: bytes, truncate_to: bool = False, on_durable=None) -> None:
         if truncate_to:
             self._backing.clear()
         self._backing.append(entry)
+        self.fsync_count += 1
         if on_durable is not None:
             on_durable()  # memory-backed: "durable" immediately
 
@@ -120,6 +124,15 @@ class DeferredMemWAL(WriteAheadLog):
         self._pending: list[tuple[bytes, bool, object]] = []
         self._timer = None
         self._dead = False
+        #: Simulated fsyncs — one per group flush, however many records it
+        #: covers (what the pipelining coalescing guards assert on).
+        self.fsync_count = 0
+        #: MetricsConsensus bundle for the coalescing-ratio gauge (the
+        #: facade wires this like the real WAL's attach_consensus_metrics).
+        self._consensus_metrics = None
+
+    def attach_consensus_metrics(self, metrics) -> None:
+        self._consensus_metrics = metrics
 
     def append(self, entry: bytes, truncate_to: bool = False, on_durable=None) -> None:
         if self._dead:
@@ -139,6 +152,10 @@ class DeferredMemWAL(WriteAheadLog):
             if truncate_to:
                 self._backing.clear()
             self._backing.append(entry)
+        if pending:
+            self.fsync_count += 1
+            if self._consensus_metrics is not None:
+                self._consensus_metrics.wal_records_per_fsync.set(len(pending))
         for _, _, on_durable in pending:
             if on_durable is not None:
                 on_durable()
@@ -233,6 +250,9 @@ class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
 
     def requests_from_proposal(self, proposal: Proposal) -> Sequence[RequestInfo]:
         return [self.inspector.request_id(r) for r in unpack_batch(proposal.payload)]
+
+    def raw_requests_from_proposal(self, proposal: Proposal) -> Sequence[bytes]:
+        return unpack_batch(proposal.payload)
 
     def auxiliary_data(self, msg: bytes) -> bytes:
         return msg

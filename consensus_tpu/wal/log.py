@@ -144,9 +144,31 @@ class WriteAheadLog:
         self._segment_index = 0
         self._crc = _INITIAL_CRC
         self._closed = False
+        #: Data-file fsyncs issued (per-append, group flush, segment roll) —
+        #: the denominator of the group-commit coalescing ratio, and what
+        #: the pipelining regression guard counts per decision.
+        self.fsync_count = 0
+        #: Entry records written since the last data fsync (the numerator).
+        self._records_since_fsync = 0
+        #: Optional MetricsConsensus bundle for the coalescing-ratio gauge.
+        self._consensus_metrics = None
         #: Entries found by :func:`open_`'s validation scan (None for a
         #: freshly created log) — lets boot avoid a second full-disk read.
         self.entries_at_open: Optional[list[bytes]] = None
+
+    def attach_consensus_metrics(self, metrics) -> None:
+        """Publish the group-commit coalescing ratio
+        (``consensus_wal_records_per_fsync``) into a MetricsConsensus
+        bundle on every data fsync."""
+        self._consensus_metrics = metrics
+
+    def _count_fsync(self) -> None:
+        self.fsync_count += 1
+        if self._consensus_metrics is not None and self._records_since_fsync:
+            self._consensus_metrics.wal_records_per_fsync.set(
+                self._records_since_fsync
+            )
+        self._records_since_fsync = 0
 
     # --- construction ------------------------------------------------------
 
@@ -293,6 +315,7 @@ class WriteAheadLog:
             try:
                 self._file.flush()
                 os.fsync(self._file.fileno())
+                self._count_fsync()
             except OSError:
                 logger.exception(
                     "WAL group fsync failed; retrying in %.3fs",
@@ -333,6 +356,8 @@ class WriteAheadLog:
             plan.crash("wal.append.torn_write")
         self._file.write(frame)
         self._file.flush()
+        if rtype == _TYPE_ENTRY:
+            self._records_since_fsync += 1
         if self._sync:
             if self._group_window:
                 # Group commit: one fsync covers every append in the window
@@ -346,6 +371,7 @@ class WriteAheadLog:
                 if plan is not None and rtype == _TYPE_ENTRY:
                     plan.crash("wal.fsync.pre")
                 os.fsync(self._file.fileno())
+                self._count_fsync()
                 if plan is not None and rtype == _TYPE_ENTRY:
                     plan.crash("wal.fsync.post")
 
@@ -365,6 +391,7 @@ class WriteAheadLog:
             self._file.flush()
             if self._sync:
                 os.fsync(self._file.fileno())
+                self._count_fsync()
             self._file.close()
         path = os.path.join(self._dir, _segment_name(index))
         self._file = open(path, "ab")

@@ -1,0 +1,419 @@
+"""Decision pipelining: the bounded in-flight proposal window.
+
+Covers the window end to end: multi-depth ordering/agreement, the
+depth-1 cold path (bit-for-bit legacy semantics), crash restore at the
+oldest undecided slot with pool re-admission of abandoned slots, the
+live view-change rule (only the oldest slot is adopted), the boot-view
+pin for the endorsement tail (ADVICE consensus.py gap), and the two
+perf regression guards the window exists for: group-commit fsyncs per
+decision and cross-slot verify launches per decision.
+"""
+
+import pytest
+
+from consensus_tpu.config import Configuration
+from consensus_tpu.core.view import Phase
+from consensus_tpu.metrics import InMemoryProvider, Metrics
+from consensus_tpu.testing import Cluster, FaultPlan, make_request
+from consensus_tpu.testing.app import unpack_batch
+from consensus_tpu.wire import (
+    Commit,
+    ProposedRecord,
+    SavedCommit,
+    SavedViewChange,
+    decode_saved,
+)
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+VICTIM = 2  # a follower in view 0
+
+
+def _delivered_raws(node) -> list[bytes]:
+    out: list[bytes] = []
+    for decision in node.app.ledger:
+        out.extend(unpack_batch(decision.proposal.payload))
+    return out
+
+
+def _assert_exactly_once(cluster, submitted: list[bytes]) -> None:
+    for node in cluster.nodes.values():
+        raws = _delivered_raws(node)
+        for raw in submitted:
+            assert raws.count(raw) == 1, (
+                f"node {node.node_id}: request {raw!r} delivered "
+                f"{raws.count(raw)} times"
+            )
+
+
+# --- ordering and agreement across depths ---------------------------------
+
+
+@pytest.mark.parametrize("depth", [2, 4, 8])
+def test_pipelined_cluster_orders_and_agrees(depth):
+    """A saturated window at every depth still yields one totally ordered,
+    agreed ledger — commit and delivery stay sequence-ordered."""
+    cluster = Cluster(
+        4,
+        seed=depth,
+        config_tweaks=dict(
+            pipeline_depth=depth,
+            request_batch_max_count=2,
+            request_batch_max_interval=0.005,
+        ),
+    )
+    cluster.start()
+    submitted = [make_request("pipe", i) for i in range(24)]
+    for raw in submitted:
+        cluster.submit_to_all(raw)
+    assert cluster.run_until_ledger(12, max_time=120.0)
+    cluster.assert_ledgers_consistent()
+    _assert_exactly_once(cluster, submitted)
+
+
+def test_depth_one_keeps_window_machinery_cold():
+    """pipeline_depth=1 (the default) must be bit-for-bit the legacy
+    protocol: the future-slot table never populates."""
+    cluster = Cluster(4, seed=11)
+    cluster.start()
+    for i in range(3):
+        cluster.submit_to_all(make_request("cold", i))
+        assert cluster.run_until_ledger(i + 1)
+    for node in cluster.nodes.values():
+        view = node.consensus.controller.curr_view
+        assert view.effective_depth == 1
+        assert view._future == {}
+    cluster.assert_ledgers_consistent()
+
+
+def test_pipeline_depth_requires_static_leader():
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        Configuration(
+            self_id=1, pipeline_depth=2, leader_rotation=True
+        ).validate()
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        Configuration(
+            self_id=1, pipeline_depth=0,
+            leader_rotation=False, decisions_per_leader=0,
+        ).validate()
+    # Static leader + depth > 1 is the supported regime.
+    Configuration(
+        self_id=1, pipeline_depth=4,
+        leader_rotation=False, decisions_per_leader=0,
+    ).validate()
+
+
+# --- crash restore: oldest slot boots, higher slots re-admit ---------------
+
+
+def _stage_window_on_victim(cluster, submitted):
+    """Drop commits inbound to the victim while peers decide: the victim is
+    left with slot 1 PREPARED (its commit persisted) and slots 2..3 merely
+    PROPOSED — three sequences in distinct phases across one WAL tail."""
+    cluster.network.lose_messages = (
+        lambda target, sender, msg: target == VICTIM
+        and isinstance(msg, Commit)
+    )
+    for raw in submitted:
+        cluster.submit_to_all(raw)
+    ok = cluster.scheduler.run_until(
+        lambda: all(
+            len(cluster.nodes[n].app.ledger) >= 3 for n in (1, 3, 4)
+        ),
+        max_time=60.0,
+    )
+    assert ok, "peer trio failed to decide ahead of the victim"
+
+
+def test_crash_with_window_in_distinct_phases_boots_oldest_and_readmits():
+    cluster = Cluster(
+        4,
+        seed=31,
+        config_tweaks=dict(
+            FAST, pipeline_depth=3, request_batch_max_count=1
+        ),
+    )
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    submitted = [make_request("cw", i) for i in range(3)]
+    _stage_window_on_victim(cluster, submitted)
+
+    view = victim.consensus.controller.curr_view
+    assert view.phase == Phase.PREPARED, "oldest slot should be PREPARED"
+    assert view.proposal_sequence == 1
+    assert {2, 3} <= set(view._future), "future slots 2,3 should be live"
+    assert victim.app.ledger == []
+
+    victim.crash()
+    cluster.network.lose_messages = None
+    victim.restart()
+
+    # Boot lands at the OLDEST undecided slot, in its pre-crash phase.
+    booted = victim.consensus.controller.curr_view
+    assert victim.consensus.controller.curr_view_number == 0
+    assert booted.proposal_sequence == 1
+    assert booted.phase == Phase.PREPARED
+
+    # The abandoned slots' requests are re-admitted to the pool.
+    cluster.scheduler.advance(0.1)
+    assert victim.consensus.pool.count == 2, (
+        "requests of abandoned slots 2,3 should be back in the pool"
+    )
+
+    # Recovery: the victim catches up; nothing is lost or delivered twice.
+    assert cluster.run_until_ledger(3, max_time=120.0)
+    cluster.assert_ledgers_consistent()
+    _assert_exactly_once(cluster, submitted)
+
+
+def test_fault_plan_crash_mid_window_save_readmits():
+    """Same staging, but death comes from the registered crash-point seam:
+    the victim dies the instant its THIRD ProposedRecord hits the WAL, so
+    the window is mid-save when the process vanishes."""
+    cluster = Cluster(
+        4,
+        seed=37,
+        config_tweaks=dict(
+            FAST, pipeline_depth=3, request_batch_max_count=1
+        ),
+    )
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    plan = FaultPlan(
+        "state.save.proposed.post", on_hit=3, label="pipeline:third-slot"
+    )
+    victim.arm_fault_plan(plan)
+    submitted = [make_request("fp", i) for i in range(3)]
+    _stage_window_on_victim(cluster, submitted)
+
+    assert plan.fired == ("state.save.proposed.post", 3), (
+        f"third slot save never crashed: hits={dict(plan.hits)}"
+    )
+    assert not victim.running
+
+    cluster.network.lose_messages = None
+    victim.restart()
+    booted = victim.consensus.controller.curr_view
+    assert booted.proposal_sequence == 1, (
+        "restore must boot at the oldest undecided slot"
+    )
+    cluster.scheduler.advance(0.1)
+    # PR3 was durable (post-seam), so BOTH higher slots re-admit.
+    assert victim.consensus.pool.count == 2
+
+    assert cluster.run_until_ledger(3, max_time=120.0)
+    cluster.assert_ledgers_consistent()
+    _assert_exactly_once(cluster, submitted)
+
+
+# --- view change: only the oldest slot survives ----------------------------
+
+
+def test_view_change_adopts_only_oldest_slot():
+    """With a full window prepared but undecidable (commits dropped), the
+    view change adopts ONLY the oldest slot; the higher slots' requests are
+    simply still pooled and get re-proposed in the new view — no request is
+    lost and none delivers twice."""
+    cluster = Cluster(
+        4,
+        seed=41,
+        config_tweaks=dict(
+            FAST, pipeline_depth=3, request_batch_max_count=1
+        ),
+    )
+    cluster.start()
+    cluster.network.lose_messages = (
+        lambda target, sender, msg: isinstance(msg, Commit)
+    )
+    submitted = [make_request("vc", i) for i in range(3)]
+    for raw in submitted:
+        cluster.submit_to_all(raw)
+    cluster.scheduler.advance(3.0)  # propose + prepare the whole window
+
+    staged = cluster.nodes[1].consensus.controller.curr_view
+    assert staged.proposal_sequence == 1
+    assert {2, 3} <= set(staged._future)
+
+    cluster.scheduler.advance(30.0)  # complaints force the view change
+    cluster.network.lose_messages = None
+    cluster.scheduler.advance(30.0)
+
+    assert cluster.run_until_ledger(3, max_time=300.0)
+    cluster.assert_ledgers_consistent()
+    _assert_exactly_once(cluster, submitted)
+    for node in cluster.nodes.values():
+        assert node.consensus.controller.curr_view_number >= 1
+
+
+# --- the boot-view pin for the endorsement tail (ADVICE gap) ---------------
+
+
+def test_crash_mid_recommit_boot_view_is_pinned():
+    """Kill the victim right after ``_commit_in_flight`` persists its
+    endorsement SavedCommit, then pin the BOOT VIEW choice consensus.py
+    ``_set_view_and_seq`` documents: the endorsement's ProposedRecord keeps
+    the proposal's ORIGINAL view stamp (restamping would fork our own
+    attestation from the commit signature already minted), the replica
+    boots in the view the buried vote abandoned — NOT above it — and the
+    restored vote immediately rejoins the pending change (+1)."""
+    cluster = Cluster(4, seed=43, config_tweaks=dict(FAST))
+    cluster.start()
+    victim = cluster.nodes[VICTIM]
+    plan = FaultPlan(
+        "state.save.endorsement_commit.post", label="pipeline:bootview"
+    )
+    victim.arm_fault_plan(plan)
+    cluster.network.lose_messages = (
+        lambda target, sender, msg: isinstance(msg, Commit)
+    )
+    cluster.submit_to_all(make_request("bv", 0))
+    cluster.scheduler.advance(3.0)
+    cluster.scheduler.advance(30.0)  # complaints -> view change -> endorsement
+    assert plan.fired is not None, f"endorsement never fired: {dict(plan.hits)}"
+
+    tail = [decode_saved(e) for e in victim.wal_backing[-3:]]
+    assert isinstance(tail[0], SavedViewChange)
+    assert isinstance(tail[1], ProposedRecord)
+    assert isinstance(tail[2], SavedCommit)
+    abandoned_view = tail[0].view_change.next_view
+    original_view = tail[1].pre_prepare.view
+    # The endorsement records carry the proposal's ORIGINAL view, which is
+    # the very view the vote abandoned (the proposal predates the change).
+    assert original_view == abandoned_view
+    assert tail[2].commit.view == original_view
+
+    cluster.network.lose_messages = None
+    victim.restart()
+    booted = victim.consensus.controller.curr_view_number
+    assert booted == original_view, (
+        f"boot view {booted}: the endorsement tail must NOT lift the boot "
+        f"view above the proposal's original view {original_view}"
+    )
+    # ... but the buried vote was restored, so the replica immediately
+    # rejoins a pending change instead of idling in the dead view (peers
+    # may have escalated past +1 meanwhile; never below it).
+    cluster.scheduler.advance(0.1)
+    assert victim.consensus.view_changer.next_view >= original_view + 1, (
+        "restored vote failed to rejoin the pending view change"
+    )
+
+    cluster.scheduler.advance(30.0)
+    cluster.submit_to_all(make_request("bv", 1))
+    assert cluster.run_until_ledger(1, max_time=600.0)
+    cluster.assert_ledgers_consistent()
+    assert victim.consensus.controller.curr_view_number > original_view, (
+        "victim never advanced past the view it died voting to leave"
+    )
+
+
+# --- perf regression guards ------------------------------------------------
+
+
+def test_group_commit_fsyncs_per_decision_guard():
+    """Under group commit, a saturated depth-4 window coalesces the two
+    protocol records per decision across slots: fsyncs per decision lands
+    near 1 (measured ~1.01), where depth 1 pays exactly 2."""
+    cluster = Cluster(
+        4,
+        seed=53,
+        config_tweaks=dict(
+            pipeline_depth=4,
+            request_batch_max_count=2,
+            request_batch_max_interval=0.005,
+            request_pool_size=1000,
+        ),
+        durability_window=0.05,
+    )
+    cluster.start()
+    for i in range(120):
+        cluster.submit_to_all(make_request("fs", i))
+    assert cluster.run_until_ledger(50, max_time=300.0)
+    cluster.assert_ledgers_consistent()
+    for node in cluster.nodes.values():
+        decisions = len(node.app.ledger)
+        ratio = node.wal.fsync_count / decisions
+        assert ratio < 1.5, (
+            f"node {node.node_id}: {node.wal.fsync_count} fsyncs for "
+            f"{decisions} decisions (ratio {ratio:.2f}) — group-commit "
+            f"coalescing regressed (depth 1 pays 2.0)"
+        )
+
+
+def test_cross_slot_verify_launches_per_decision_guard():
+    """A replica that receives a window's worth of traffic in one burst
+    (unordered transport — the oldest slot's commits arrive last) verifies
+    every slot's commit votes in ONE coalesced launch and then decides the
+    promoted slots from the cached results: launches per decision < 1."""
+    cluster = Cluster(
+        4,
+        seed=59,
+        config_tweaks=dict(
+            pipeline_depth=4,
+            request_batch_max_count=2,
+            request_batch_max_interval=0.005,
+            request_forward_timeout=5.0,
+            request_complain_timeout=50.0,
+            leader_heartbeat_timeout=100.0,
+        ),
+    )
+    provider = InMemoryProvider()
+    cluster.nodes[VICTIM].metrics = Metrics(provider)
+    cluster.start()
+
+    held = []
+
+    def hold(target, sender, msg):
+        if target == VICTIM and not isinstance(msg, bytes):
+            held.append((sender, msg))
+            return True
+        return False
+
+    cluster.network.lose_messages = hold
+    for i in range(8):
+        cluster.submit_to_all(make_request("cs", i))
+    ok = cluster.scheduler.run_until(
+        lambda: all(
+            len(cluster.nodes[n].app.ledger) >= 4 for n in (1, 3, 4)
+        ),
+        max_time=60.0,
+    )
+    assert ok, "peer trio failed to race ahead of the victim"
+
+    cluster.network.lose_messages = None
+    handler = cluster.network._handlers[VICTIM]
+    # Unordered transport (api.Comm contract): the oldest slot's commits
+    # arrive last, after the future slots' votes are already buffered.
+    oldest_commits = [
+        (s, m) for s, m in held if isinstance(m, Commit) and m.seq == 1
+    ]
+    rest = [
+        (s, m)
+        for s, m in held
+        if not (isinstance(m, Commit) and m.seq == 1)
+    ]
+    for sender, msg in rest + oldest_commits:
+        handler(sender, msg, False)
+
+    ok = cluster.scheduler.run_until(
+        lambda: len(cluster.nodes[VICTIM].app.ledger) >= 4, max_time=60.0
+    )
+    assert ok, "victim failed to drain the burst"
+
+    launches = provider.value("consensus_verify_launches")
+    decisions = len(cluster.nodes[VICTIM].app.ledger)
+    assert launches / decisions < 1.0, (
+        f"{launches} verify launches for {decisions} decisions — cross-slot "
+        f"coalescing regressed (promoted slots should decide from cache)"
+    )
+    batches = provider.observations("consensus_cross_slot_verify_batch")
+    assert max(batches) > 2, (
+        f"largest verify batch {max(batches)} never spanned slots: {batches}"
+    )
+    cluster.assert_ledgers_consistent()
