@@ -11,11 +11,18 @@ the baseline; its cell also emits the historical ``naive_chain_tx_per_sec``
 record.
 
 Run: python benchmarks/chain_tps.py [n_replicas] [seconds] [depths-csv]
-Prints one JSON line per depth plus a speedup summary line.
+                                    [--trace out.json]
+Prints one JSON line per depth plus a speedup summary line.  With
+``--trace``, the leader runs with the decision tracer enabled: each cell
+writes a Chrome/Perfetto trace (suffixed ``.d<depth>.json`` when sweeping
+several depths), prints the critical-path phase-breakdown table, and emits
+a machine-readable ``chain_tps_trace_summary`` JSON line (tps, latency
+p50/p99, per-phase p50/p99).
 """
 
 from __future__ import annotations
 
+import argparse
 import itertools
 import json
 import os
@@ -31,10 +38,11 @@ import jax
 jax.config.update("jax_platforms", "cpu")  # protocol-only bench: no device
 
 from benchmarks._harness import start_feeder, start_replicas, teardown
-from consensus_tpu.config import Configuration
+from consensus_tpu.config import Configuration, TraceConfig
 from consensus_tpu.metrics import InMemoryProvider, Metrics
 from consensus_tpu.testing.app import TestApp as PortsApp
 from consensus_tpu.testing.app import make_request
+from consensus_tpu.trace import build_report, format_table, write_chrome_trace
 
 
 def _percentile(sorted_values: list[float], q: float) -> float:
@@ -44,7 +52,9 @@ def _percentile(sorted_values: list[float], q: float) -> float:
     return sorted_values[idx]
 
 
-def run_cell(n: int, duration: float, depth: int) -> dict:
+def run_cell(
+    n: int, duration: float, depth: int, trace_path: str | None = None
+) -> dict:
     """One sweep cell: a fresh cluster at ``pipeline_depth=depth``.
 
     Each replica persists to a real fsync-backed WAL and batches are kept
@@ -63,6 +73,12 @@ def run_cell(n: int, duration: float, depth: int) -> dict:
             request_batch_max_interval=0.005,
             request_pool_size=2000,
             pipeline_depth=depth,
+            # Only the leader is traced: the phase chains of interest all
+            # live on node 1, and a follower's ring would just burn memory.
+            trace=TraceConfig(
+                enabled=trace_path is not None and node_id == 1,
+                capacity=1 << 20,
+            ),
         )
 
     wal_root = tempfile.mkdtemp(prefix=f"chain_tps_d{depth}_")
@@ -118,10 +134,48 @@ def run_cell(n: int, duration: float, depth: int) -> dict:
     window_lat = sorted(latencies()[start_lat:])
     stop.set()
 
+    trace_report = None
+    if trace_path is not None:
+        # Read the ring before teardown kills the components that feed it.
+        tracer = replicas[1].tracer
+        events = tracer.events()
+        write_chrome_trace(trace_path, events, pid=1)
+        trace_report = build_report(events)
+        print(f"# trace: {trace_path} ({len(events)} events, "
+              f"{tracer.dropped} dropped)", flush=True)
+        print(format_table(trace_report), flush=True)
+
     teardown(replicas, comms, schedulers, cluster)
     shutil.rmtree(wal_root, ignore_errors=True)
 
     blocks = end_blocks - start_blocks
+    if trace_report is not None:
+        print(
+            json.dumps({
+                "metric": "chain_tps_trace_summary",
+                "pipeline_depth": depth,
+                "n": n,
+                "trace_file": trace_path,
+                "tps": round((end_tx - start_tx) / elapsed, 1),
+                "decision_latency_p50_ms": round(
+                    _percentile(window_lat, 0.50) * 1000, 2
+                ),
+                "decision_latency_p99_ms": round(
+                    _percentile(window_lat, 0.99) * 1000, 2
+                ),
+                "decisions_traced": trace_report["n_decisions"],
+                "complete_chains": trace_report["n_complete"],
+                "phase_breakdown_ms": {
+                    phase: {
+                        "p50": round(stats["p50"] * 1000, 3),
+                        "p99": round(stats["p99"] * 1000, 3),
+                    }
+                    for phase, stats in
+                    trace_report["phase_percentiles"].items()
+                },
+            }),
+            flush=True,
+        )
     return {
         "metric": "chain_tps_pipeline_sweep",
         "pipeline_depth": depth,
@@ -140,18 +194,42 @@ def run_cell(n: int, duration: float, depth: int) -> dict:
     }
 
 
+def _trace_path_for(base: str | None, depth: int, n_depths: int) -> str | None:
+    if base is None:
+        return None
+    if n_depths == 1:
+        return base
+    root, ext = os.path.splitext(base)
+    return f"{root}.d{depth}{ext or '.json'}"
+
+
 def main() -> None:
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4
-    duration = float(sys.argv[2]) if len(sys.argv) > 2 else 10.0
-    depths = (
-        [int(d) for d in sys.argv[3].split(",")]
-        if len(sys.argv) > 3
-        else [1, 2, 4, 8]
+    parser = argparse.ArgumentParser(
+        description="naive_chain TPS sweep over pipeline depths"
     )
+    parser.add_argument("n", nargs="?", type=int, default=4)
+    parser.add_argument("seconds", nargs="?", type=float, default=10.0)
+    parser.add_argument("depths", nargs="?", default="1,2,4,8")
+    parser.add_argument(
+        "--trace",
+        metavar="OUT.json",
+        default=None,
+        help="write the leader's Chrome/Perfetto trace per depth and print "
+        "the critical-path phase breakdown",
+    )
+    opts = parser.parse_args()
+    n = opts.n
+    duration = opts.seconds
+    depths = [int(d) for d in str(opts.depths).split(",")]
 
     results = {}
     for depth in depths:
-        cell = run_cell(n, duration, depth)
+        cell = run_cell(
+            n,
+            duration,
+            depth,
+            trace_path=_trace_path_for(opts.trace, depth, len(depths)),
+        )
         results[depth] = cell
         print(json.dumps(cell), flush=True)
         if depth == 1:

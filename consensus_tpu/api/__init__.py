@@ -12,6 +12,7 @@ from consensus_tpu.api.deps import (  # noqa: F401
     RequestInspector,
     Signer,
     Synchronizer,
+    TracerPort,
     Verifier,
     WriteAheadLog,
 )

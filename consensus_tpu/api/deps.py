@@ -224,6 +224,27 @@ class Synchronizer(abc.ABC):
     def sync(self) -> SyncResponse: ...
 
 
+class TracerPort(abc.ABC):
+    """Decision-lifecycle tracing sink (no reference counterpart).
+
+    Implemented by ``trace.Tracer`` and ``trace.NoopTracer``.  Call sites
+    MUST guard emission with ``if tracer.enabled:`` so the disabled hot
+    path stays allocation-free; ``seq``/``view`` key per-decision spans.
+    """
+
+    #: False on the no-op tracer; the emission guard reads this.
+    enabled: bool = False
+
+    @abc.abstractmethod
+    def begin(self, track: str, name: str, *, seq=None, view=None, **args) -> None: ...
+
+    @abc.abstractmethod
+    def end(self, track: str, name: str, *, seq=None, view=None, **args) -> None: ...
+
+    @abc.abstractmethod
+    def instant(self, track: str, name: str, *, seq=None, view=None, **args) -> None: ...
+
+
 __all__ = [
     "Application",
     "Comm",
@@ -235,5 +256,6 @@ __all__ = [
     "MembershipNotifier",
     "RequestInspector",
     "Synchronizer",
+    "TracerPort",
     "Decision",
 ]

@@ -14,6 +14,20 @@ from dataclasses import dataclass, field, replace
 
 
 @dataclass(frozen=True)
+class TraceConfig:
+    """Decision-lifecycle tracing knob (no reference counterpart).
+
+    Default-off; when enabled the consensus facade builds a
+    ``trace.Tracer`` over the injected scheduler clock, so traces stay
+    deterministic under ``SimScheduler``.  ``capacity`` bounds the event
+    ring — oldest events are overwritten, memory never grows.
+    """
+
+    enabled: bool = False
+    capacity: int = 65536
+
+
+@dataclass(frozen=True)
 class Configuration:
     # --- identity -------------------------------------------------------
     self_id: int = 0
@@ -78,6 +92,9 @@ class Configuration:
     # avoids recompilation across batch sizes).
     crypto_pad_pow2: bool = True
 
+    # --- decision-lifecycle tracing (no reference counterpart) ----------
+    trace: TraceConfig = field(default=TraceConfig())
+
     def validate(self) -> None:
         """Cross-field validation. Parity: reference pkg/types/config.go:116-188."""
         errs = []
@@ -135,6 +152,8 @@ class Configuration:
             errs.append("pipeline_depth must be >= 1")
         if self.pipeline_depth > 1 and self.leader_rotation:
             errs.append("pipeline_depth > 1 requires leader_rotation off")
+        if self.trace.capacity < 1:
+            errs.append("trace.capacity must be >= 1")
         if errs:
             raise ValueError("invalid configuration: " + "; ".join(errs))
 
@@ -152,4 +171,4 @@ def default_config(self_id: int) -> Configuration:
     return cfg
 
 
-__all__ = ["Configuration", "default_config"]
+__all__ = ["Configuration", "TraceConfig", "default_config"]

@@ -38,6 +38,7 @@ from consensus_tpu.core.state import InFlightData, PersistedState, ProposalMaker
 from consensus_tpu.core.view import View
 from consensus_tpu.metrics import Metrics
 from consensus_tpu.runtime.scheduler import Scheduler
+from consensus_tpu.trace.tracer import tracer_from_config
 from consensus_tpu.types import Checkpoint, Proposal, Reconfig, Signature
 from consensus_tpu.wire import ConsensusMessage, ViewMetadata, decode_view_metadata
 
@@ -65,6 +66,7 @@ class Consensus:
         last_signatures: Sequence[Signature] = (),
         membership_notifier: Optional[MembershipNotifier] = None,
         metrics: Optional[Metrics] = None,
+        tracer=None,
     ) -> None:
         self.config = config
         self.scheduler = scheduler
@@ -81,6 +83,17 @@ class Consensus:
         self.last_signatures = tuple(last_signatures)
         self.membership_notifier = membership_notifier
         self.metrics = metrics or Metrics()
+        # Decision-lifecycle tracing: default-off (the shared no-op keeps
+        # every instrumented site to one attribute check).  An embedder may
+        # inject a tracer to share one event stream across components it
+        # builds itself (e.g. the sync client).
+        if tracer is None:
+            tracer = tracer_from_config(
+                config.trace, scheduler.now, pid=config.self_id
+            )
+        self.tracer = tracer
+        if hasattr(synchronizer, "attach_tracer"):
+            synchronizer.attach_tracer(tracer)
         # The WAL is constructed by the embedder (it may pre-exist restart);
         # attach the facade's WAL bundle here so wal_count_of_files is live
         # without the embedder threading metrics twice.  Parity: reference
@@ -92,6 +105,8 @@ class Consensus:
             wal.attach_metrics(self.metrics.wal)
         if hasattr(wal, "attach_consensus_metrics"):
             wal.attach_consensus_metrics(self.metrics.consensus)
+        if hasattr(wal, "attach_tracer"):
+            wal.attach_tracer(tracer)
 
         self.nodes: tuple[int, ...] = ()
         self.controller: Optional[Controller] = None
@@ -252,6 +267,7 @@ class Consensus:
             view_changer=None,
             on_reconfig=self._on_reconfig,
             metrics=self.metrics,
+            tracer=self.tracer,
         )
         self.controller = controller
 
@@ -277,6 +293,7 @@ class Consensus:
                 timeout_handler=controller,
                 on_submitted=self._on_pool_submitted,
                 metrics=self.metrics.request_pool,
+                tracer=self.tracer,
             )
         self.pool = pool
         batcher = Batcher(
@@ -285,6 +302,7 @@ class Consensus:
             batch_max_count=cfg.request_batch_max_count,
             batch_max_bytes=cfg.request_batch_max_bytes,
             batch_max_interval=cfg.request_batch_max_interval,
+            tracer=self.tracer,
         )
         self.batcher = batcher
         leader_monitor = HeartbeatMonitor(
@@ -373,6 +391,7 @@ class Consensus:
             metrics=self.metrics.view,
             pipeline_depth=self.config.pipeline_depth,
             consensus_metrics=self.metrics.consensus,
+            tracer=self.tracer,
         )
 
     def _start_components(self, view: int, seq: int, dec: int) -> None:

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 from consensus_tpu.core.pool import RequestPool
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
+from consensus_tpu.trace.tracer import NOOP_TRACER
 
 logger = logging.getLogger("consensus_tpu.batcher")
 
@@ -31,6 +32,7 @@ class Batcher:
         batch_max_count: int,
         batch_max_bytes: int,
         batch_max_interval: float,
+        tracer=None,
     ) -> None:
         self._sched = scheduler
         self._pool = pool
@@ -40,6 +42,7 @@ class Batcher:
         self._pending_cb: Optional[Callable[[list[bytes]], None]] = None
         self._timer: Optional[TimerHandle] = None
         self._closed = False
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
 
     def next_batch(self, on_batch: Callable[[list[bytes]], None]) -> None:
         """Request the next batch; at most one outstanding request.
@@ -82,7 +85,10 @@ class Batcher:
         cb(self._take())
 
     def _take(self) -> list[bytes]:
-        return self._pool.next_requests(self._max_count, self._max_bytes)
+        batch = self._pool.next_requests(self._max_count, self._max_bytes)
+        if batch and self._tracer.enabled:
+            self._tracer.instant("batcher", "batch.take", count=len(batch))
+        return batch
 
     def cancel(self) -> None:
         """Abandon any outstanding request without calling back."""

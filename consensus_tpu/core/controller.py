@@ -40,6 +40,7 @@ from consensus_tpu.core.state import InFlightData, PersistedState, ProposalMaker
 from consensus_tpu.core.view import Phase, View
 from consensus_tpu.metrics import Metrics
 from consensus_tpu.runtime.scheduler import Scheduler
+from consensus_tpu.trace.tracer import NOOP_TRACER
 from consensus_tpu.types import Checkpoint, Proposal, Reconfig, RequestInfo, Signature
 from consensus_tpu.utils.leader import get_leader_id
 from consensus_tpu.utils.quorum import compute_quorum
@@ -102,6 +103,7 @@ class Controller:
         view_changer: Optional[ViewChangerPort] = None,
         on_reconfig: Optional[Callable[[Reconfig], None]] = None,
         metrics: Optional[Metrics] = None,
+        tracer=None,
     ) -> None:
         self._sched = scheduler
         self._config = config
@@ -126,6 +128,7 @@ class Controller:
         self.view_changer = view_changer
         self._on_reconfig = on_reconfig
         self.metrics = metrics or Metrics()
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
 
         self.curr_view_number = 0
         self.curr_decisions_in_view = 0
@@ -247,6 +250,14 @@ class Controller:
             "%d: started view %d at seq %d (leader %d)",
             self.id, self.curr_view_number, proposal_sequence, self.leader_id(),
         )
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "controller",
+                "viewchange.exit",
+                view=self.curr_view_number,
+                seq=proposal_sequence,
+                leader=self.leader_id(),
+            )
 
     def change_view(
         self, new_view_number: int, new_proposal_sequence: int, new_decisions: int
@@ -272,6 +283,8 @@ class Controller:
     def _abort_view(self, view: int) -> bool:
         if view < self.curr_view_number:
             return False
+        if self._tracer.enabled:
+            self._tracer.instant("controller", "viewchange.enter", view=view)
         self._leader_token = False
         if self.curr_view is not None:
             self.curr_view.abort()
@@ -408,6 +421,16 @@ class Controller:
             return
         metadata = self.curr_view.get_metadata()
         proposal = self._assembler.assemble_proposal(metadata, batch)
+        if self._tracer.enabled:
+            # Stamped with the slot this proposal will occupy (read before
+            # propose() advances it) so the report can join seal -> phases.
+            self._tracer.instant(
+                "controller",
+                "batch.seal",
+                seq=self.curr_view.next_propose_seq,
+                view=self.curr_view_number,
+                count=len(batch),
+            )
         self.curr_view.propose(proposal)
         if self.curr_view.effective_depth > 1:
             # The batch now rides an in-flight slot while still pooled
@@ -482,9 +505,21 @@ class Controller:
             # their reservations would pin pooled requests forever.
             self.pool.release_reservations()
             return response.reconfig
+        tracing = self._tracer.enabled
+        if tracing:
+            self._tracer.begin(
+                "view", "phase.deliver", seq=md.latest_sequence, view=md.view_id
+            )
         begin = self._sched.now()
         reconfig = self._application.deliver(proposal, signatures)
         self.metrics.view.latency_batch_save.observe(self._sched.now() - begin)
+        if tracing:
+            self._tracer.end(
+                "view", "phase.deliver", seq=md.latest_sequence, view=md.view_id
+            )
+            self._tracer.end(
+                "view", "decision", seq=md.latest_sequence, view=md.view_id
+            )
         self.checkpoint.set(proposal, signatures)
         # Forget the delivered slot's mem-window/in-flight entries: with a
         # pipelined window the view changer must only ever see the OLDEST
@@ -573,7 +608,11 @@ class Controller:
         self._sync_in_progress = True
         sync_begin = self._sched.now()
 
+        if self._tracer.enabled:
+            self._tracer.begin("controller", "sync")
         response = self._synchronizer.sync()
+        if self._tracer.enabled:
+            self._tracer.end("controller", "sync")
         if response.reconfig.in_latest_decision:
             self._sync_in_progress = False
             if self._on_reconfig is not None:

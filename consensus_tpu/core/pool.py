@@ -31,6 +31,7 @@ from typing import Callable, Iterable, Optional, Protocol, Sequence
 from consensus_tpu.api.deps import RequestInspector
 from consensus_tpu.metrics import MetricsRequestPool, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
+from consensus_tpu.trace.tracer import NOOP_TRACER
 from consensus_tpu.types import RequestInfo
 
 logger = logging.getLogger("consensus_tpu.pool")
@@ -100,6 +101,7 @@ class RequestPool:
         timeout_handler: Optional[RequestTimeoutHandler] = None,
         on_submitted: Optional[Callable[[], None]] = None,
         metrics: Optional[MetricsRequestPool] = None,
+        tracer=None,
     ) -> None:
         self._sched = scheduler
         self._inspector = inspector
@@ -121,6 +123,7 @@ class RequestPool:
         self._timers_stopped = False
         self._closed = False
         self._metrics = metrics or MetricsRequestPool(NoopProvider())
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
 
     # --- admission ---------------------------------------------------------
 
@@ -179,6 +182,8 @@ class RequestPool:
 
     def _admit(self, raw: bytes, info: RequestInfo) -> None:
         entry = _Entry(raw, info, self._sched.now())
+        if self._tracer.enabled:
+            self._tracer.instant("pool", "pool.admit")
         self._fifo[info.key()] = entry
         self._bytes += len(raw)
         self._metrics.count_of_elements.set(len(self._fifo))
@@ -283,6 +288,9 @@ class RequestPool:
         they ride an in-flight pipelined proposal.  Without this a depth>1
         leader would re-batch the pool front into the next slot (removal
         only happens at delivery) and decide every request twice."""
+        if self._tracer.enabled:
+            raw_requests = list(raw_requests)
+            self._tracer.instant("pool", "pool.reserve", count=len(raw_requests))
         for raw in raw_requests:
             try:
                 key = self._inspector.request_id(raw).key()

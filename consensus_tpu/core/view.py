@@ -31,6 +31,7 @@ from typing import Callable, Optional, Protocol, Sequence
 from consensus_tpu.api.deps import MembershipNotifier, Signer, Verifier
 from consensus_tpu.metrics import MetricsConsensus, MetricsView, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler
+from consensus_tpu.trace.tracer import NOOP_TRACER
 from consensus_tpu.types import Proposal, RequestInfo, Signature
 from consensus_tpu.utils.digests import commit_signatures_digest
 from consensus_tpu.utils.blacklist import compute_blacklist_update
@@ -165,6 +166,7 @@ class View:
         metrics: Optional[MetricsView] = None,
         pipeline_depth: int = 1,
         consensus_metrics: Optional[MetricsConsensus] = None,
+        tracer=None,
     ) -> None:
         self._sched = scheduler
         self.self_id = self_id
@@ -231,6 +233,7 @@ class View:
         #: stronger endorsement) is blocked until a view change resolves it.
         self.endorsement_blocked = False
         self._begin_pre_prepare = 0.0
+        self._tracer = tracer if tracer is not None else NOOP_TRACER
         self.metrics = metrics or MetricsView(NoopProvider())
         self.metrics.view_number.set(number)
         self.metrics.leader_id.set(leader_id)
@@ -322,6 +325,10 @@ class View:
 
     def abort(self) -> None:
         """Parity: reference view.go Abort/stop."""
+        if not self.stopped and self._tracer.enabled:
+            self._tracer.instant(
+                "view", "view.abort", seq=self.proposal_sequence, view=self.number
+            )
         self.stopped = True
         self.phase = Phase.ABORT
         self.metrics.phase.set(int(self.phase))
@@ -466,6 +473,10 @@ class View:
         i_am_leader = self.self_id == self.leader_id
         prepare = Prepare(view=self.number, seq=seq, digest=proposal.digest())
         gate = {"durable": False, "verified": False, "prepare_sent": False}
+        tracer = self._tracer
+        if tracer.enabled:
+            tracer.begin("view", "decision", seq=seq, view=self.number)
+            tracer.begin("view", "phase.pre_prepare", seq=seq, view=self.number)
 
         def maybe_send_prepare() -> None:
             if not (gate["durable"] and gate["verified"]) or gate["prepare_sent"]:
@@ -517,6 +528,12 @@ class View:
                 "%d: bad pipelined proposal from leader %d at seq %d: %s",
                 self.self_id, self.leader_id, seq, err,
             )
+            if tracer.enabled:
+                tracer.instant(
+                    "view", "proposal.rejected", seq=seq, view=self.number
+                )
+                tracer.end("view", "phase.pre_prepare", seq=seq, view=self.number)
+                tracer.end("view", "decision", seq=seq, view=self.number)
             self._failure_detector.complain(self.number, False)
             self._sync.sync()
             self.abort()
@@ -526,6 +543,15 @@ class View:
         slot.requests = tuple(requests)
         slot.processed = True
         slot.begin = self._sched.now()
+        if tracer.enabled:
+            tracer.end(
+                "view",
+                "phase.pre_prepare",
+                seq=seq,
+                view=self.number,
+                txs=len(requests),
+            )
+            tracer.begin("view", "phase.prepare", seq=seq, view=self.number)
         if i_am_leader:
             self._state.mark_proposed_verified(self.number, seq)
         else:
@@ -574,6 +600,17 @@ class View:
         self._pending_pre_prepare = None
         proposal = pp.proposal
         i_am_leader = self.self_id == self.leader_id
+        tracer = self._tracer
+        if tracer.enabled:
+            tracer.begin(
+                "view", "decision", seq=self.proposal_sequence, view=self.number
+            )
+            tracer.begin(
+                "view",
+                "phase.pre_prepare",
+                seq=self.proposal_sequence,
+                view=self.number,
+            )
 
         prepare = Prepare(
             view=self.number, seq=self.proposal_sequence, digest=proposal.digest()
@@ -675,6 +712,23 @@ class View:
             logger.warning(
                 "%d: bad proposal from leader %d: %s", self.self_id, self.leader_id, err
             )
+            if tracer.enabled:
+                # Close the spans so rejected slots cannot corrupt nesting.
+                tracer.instant(
+                    "view",
+                    "proposal.rejected",
+                    seq=self.proposal_sequence,
+                    view=self.number,
+                )
+                tracer.end(
+                    "view",
+                    "phase.pre_prepare",
+                    seq=self.proposal_sequence,
+                    view=self.number,
+                )
+                tracer.end(
+                    "view", "decision", seq=self.proposal_sequence, view=self.number
+                )
             self._failure_detector.complain(self.number, False)
             self._sync.sync()
             self.abort()
@@ -689,6 +743,17 @@ class View:
         self._begin_pre_prepare = self._sched.now()
         self.phase = Phase.PROPOSED
         self.metrics.phase.set(int(self.phase))
+        if tracer.enabled:
+            tracer.end(
+                "view",
+                "phase.pre_prepare",
+                seq=self.proposal_sequence,
+                view=self.number,
+                txs=len(requests),
+            )
+            tracer.begin(
+                "view", "phase.prepare", seq=self.proposal_sequence, view=self.number
+            )
         if i_am_leader:
             # Verification succeeded: flip the in-memory record so a mid-run
             # view restart (reseed_if_inflight_matches) does not pay a
@@ -718,6 +783,17 @@ class View:
         if len(voters) < self.quorum - 1:
             return
 
+        if self._tracer.enabled:
+            self._tracer.end(
+                "view",
+                "phase.prepare",
+                seq=self.proposal_sequence,
+                view=self.number,
+                prepares=len(voters),
+            )
+            self._tracer.begin(
+                "view", "phase.commit", seq=self.proposal_sequence, view=self.number
+            )
         aux = encode_prepares_from(PreparesFrom(ids=tuple(sorted(voters))))
         self.my_commit_signature = self._signer.sign_proposal(
             self.in_flight_proposal, aux
@@ -730,6 +806,10 @@ class View:
         )
 
         def send_after_durable() -> None:
+            if self._tracer.enabled:
+                self._tracer.instant(
+                    "view", "commit.durable", seq=commit.seq, view=commit.view
+                )
             if self.stopped:
                 return  # aborted view: never utter stale-view votes
             assist_copy = Commit(
@@ -789,6 +869,14 @@ class View:
         self.metrics.latency_batch_processing.observe(
             self._sched.now() - self._begin_pre_prepare
         )
+        if self._tracer.enabled:
+            self._tracer.end(
+                "view",
+                "phase.commit",
+                seq=self.proposal_sequence,
+                view=self.number,
+                commits=len(signatures),
+            )
         self._start_next_seq()
         self._decider.decide(proposal, signatures, requests)
 
@@ -857,6 +945,10 @@ class View:
             if cm is not None:
                 cm.count_verify_launches.add(1)
                 cm.cross_slot_verify_batch.observe(len(sigs))
+            if self._tracer.enabled:
+                # Same value the cross_slot_verify_batch histogram observes:
+                # the trace and metrics views of launch batching must agree.
+                self._tracer.instant("view", "verify.launch", size=len(sigs))
             return self._verifier.verify_consenter_sigs_batch(
                 sigs, self.in_flight_proposal
             )
@@ -871,6 +963,10 @@ class View:
         if cm is not None:
             cm.count_verify_launches.add(1)
             cm.cross_slot_verify_batch.observe(total)
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "view", "verify.launch", size=total, slots=len(groups)
+            )
         all_results = multi(groups)
         for (slot, extra), slot_results in zip(future_groups, all_results[1:]):
             for commit, result in zip(extra, slot_results):

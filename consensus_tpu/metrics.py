@@ -14,6 +14,16 @@ import abc
 from typing import Optional, Sequence
 
 
+#: Stable, documented instrument names for the latency-attribution pair the
+#: tracer mirrors (see consensus_tpu/trace/): the decision tracer's
+#: ``verify.launch`` instants carry the exact values this histogram observes,
+#: and its ``wal.fsync`` instants carry the per-flush record counts behind
+#: this gauge.  Tests and embedder dashboards key on these constants, not on
+#: string literals, so a rename breaks loudly.
+VERIFY_LAUNCH_BATCH_KEY = "consensus_cross_slot_verify_batch"
+WAL_RECORDS_PER_FSYNC_KEY = "consensus_wal_records_per_fsync"
+
+
 class Counter(abc.ABC):
     @abc.abstractmethod
     def add(self, delta: float = 1.0) -> None: ...
@@ -163,6 +173,21 @@ class InMemoryProvider(Provider):
 
     def observations(self, name: str) -> list[float]:
         return self.instruments[name].observations
+
+    def dump(self) -> dict[str, dict]:
+        """Stable snapshot of every instrument, sorted by name: ``{name:
+        {"value": <counter/gauge value>, "observations": [histogram
+        samples]}}``.  The machine-readable surface the bench harness and
+        trace-parity tests consume — names here are the documented contract
+        (see :data:`VERIFY_LAUNCH_BATCH_KEY` /
+        :data:`WAL_RECORDS_PER_FSYNC_KEY`)."""
+        return {
+            name: {
+                "value": inst.value,
+                "observations": list(inst.observations),
+            }
+            for name, inst in sorted(self.instruments.items())
+        }
 
 
 # --- instrument bundles (names mirror reference pkg/api/metrics.go) --------
@@ -415,4 +440,6 @@ __all__ = [
     "MetricsWAL",
     "MetricsSync",
     "extend_label_names",
+    "VERIFY_LAUNCH_BATCH_KEY",
+    "WAL_RECORDS_PER_FSYNC_KEY",
 ]

@@ -260,7 +260,8 @@ class ThreadCoalescingVerifier:
         so the flusher (once it unwedges / recovers) runs a device flush and
         clears the flag.  At most one probe is queued at a time, and probes
         are rate-limited — a stuck flusher can't accumulate a backlog."""
-        now = time.monotonic()
+        # Real-thread probe rate limit: this path runs outside the scheduler.
+        now = time.monotonic()  # wallclock-ok
         with self._cv:
             if (
                 self._closed
@@ -348,9 +349,9 @@ class ThreadCoalescingVerifier:
                     self._cv.wait()
                 if not self._pending and self._closed:
                     return
-                deadline = time.monotonic() + self._window
+                deadline = time.monotonic() + self._window  # wallclock-ok
                 while self._count < self._max_batch and not self._closed:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - time.monotonic()  # wallclock-ok
                     if remaining <= 0:
                         break
                     self._cv.wait(remaining)

@@ -411,10 +411,14 @@ class SidecarVerifierClient:
         probe_interval: float = 10.0,
         auth_secret: Optional[bytes] = None,
         fault_plan=None,
+        tracer=None,
     ) -> None:
         #: Optional testing FaultPlan (consensus_tpu/testing/faults.py):
         #: arms the sidecar.send.io_error / sidecar.recv.short_read seams.
         self.fault_plan = fault_plan
+        #: Optional decision-lifecycle tracer.  verify_batch runs on caller
+        #: threads, so posted instants rely on the tracer's internal lock.
+        self._tracer = tracer
         self._address = address
         self._timeout = request_timeout
         self._connect_timeout = connect_timeout
@@ -447,6 +451,9 @@ class SidecarVerifierClient:
             raise ValueError("batch length mismatch")
         if n == 0:
             return np.zeros(0, dtype=bool)
+        tracer = self._tracer
+        if tracer is not None and tracer.enabled:
+            tracer.instant("net", "sidecar.verify", n=n)
         if self._suspect and self._local is not None:
             # Wedged sidecar: don't stall request_timeout on every call —
             # the background probe clears the flag when it recovers.
@@ -472,6 +479,8 @@ class SidecarVerifierClient:
                 exc,
                 n,
             )
+            if tracer is not None and tracer.enabled:
+                tracer.instant("net", "sidecar.fallback", n=n)
             return np.asarray(
                 self._local.verify_host(messages, signatures, public_keys)
             )
@@ -607,7 +616,8 @@ class SidecarVerifierClient:
         # response wait) so a call behind a stalled sender still fails over
         # within its own budget rather than 3x it.
         budget = timeout if timeout is not None else self._timeout
-        deadline = time.monotonic() + budget
+        # Real-thread I/O deadline: this path runs outside the scheduler.
+        deadline = time.monotonic() + budget  # wallclock-ok
 
         def _give_up_queued(reason: str):
             # Budget spent without touching the wire: the socket is healthy,
@@ -623,7 +633,7 @@ class SidecarVerifierClient:
         try:
             if waiter["event"].is_set():
                 raise ConnectionError("sidecar connection lost before send")
-            if deadline - time.monotonic() <= 0:
+            if deadline - time.monotonic() <= 0:  # wallclock-ok
                 raise _give_up_queued(
                     f"sidecar send queue stalled for {budget}s"
                 )
@@ -648,7 +658,7 @@ class SidecarVerifierClient:
             raise
         finally:
             wlock.release()
-        if not waiter["event"].wait(max(0.0, deadline - time.monotonic())):
+        if not waiter["event"].wait(max(0.0, deadline - time.monotonic())):  # wallclock-ok
             with self._lock:
                 self._pending.pop(req_id, None)
             raise TimeoutError(f"sidecar did not answer within {budget}s")

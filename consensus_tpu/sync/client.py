@@ -101,6 +101,8 @@ class LedgerSynchronizer(Synchronizer):
             metrics = MetricsSync(NoopProvider())
         self.metrics = metrics
         self.fault_plan = fault_plan
+        #: Optional decision-lifecycle tracer (trace.Tracer); None when off.
+        self._tracer = None
         self._now = now
         self.chunk_window = chunk_window
         self.max_fetch_failures = max_fetch_failures
@@ -108,6 +110,10 @@ class LedgerSynchronizer(Synchronizer):
         self.threshold = threshold
         #: Peer scores persist across sync() calls (higher is better).
         self.scores: Dict[int, float] = {}
+
+    def attach_tracer(self, tracer) -> None:
+        """Emit chunk fetch/verify spans into a decision tracer."""
+        self._tracer = tracer
 
     # --- peer scoring ------------------------------------------------------
 
@@ -168,7 +174,19 @@ class LedgerSynchronizer(Synchronizer):
             request = SyncRequest(
                 from_seq=mine + 1, to_seq=min(target, mine + self.chunk_window)
             )
+            tracer = self._tracer
+            tracing = tracer is not None and tracer.enabled
+            if tracing:
+                tracer.begin(
+                    "sync",
+                    "sync.fetch",
+                    peer=peer,
+                    from_seq=request.from_seq,
+                    to_seq=request.to_seq,
+                )
             reply = self.transport.fetch(peer, request)
+            if tracing:
+                tracer.end("sync", "sync.fetch", ok=reply is not None)
             if reply is None:
                 failures[peer] = failures.get(peer, 0) + 1
                 self._demote(peer, _DEMOTE_FETCH)
@@ -177,7 +195,11 @@ class LedgerSynchronizer(Synchronizer):
                 # Peer is shorter than it claimed at probe time.
                 heights[peer] = min(heights[peer], reply.height)
                 continue
+            if tracing:
+                tracer.begin("sync", "sync.apply", from_seq=mine + 1)
             applied = self._verify_and_apply(reply, expected_from=mine + 1)
+            if tracing:
+                tracer.end("sync", "sync.apply", ok=applied is not None)
             if applied is None:
                 logger.warning(
                     "%d: peer %d served a chunk that failed verification; "

@@ -94,12 +94,22 @@ class MemWAL(WriteAheadLog):
         #: Simulated fsyncs — per append here (no group window), so the
         #: pipelining coalescing guards can count them like the real WAL's.
         self.fsync_count = 0
+        self._tracer = None
+
+    def attach_tracer(self, tracer) -> None:
+        self._tracer = tracer
 
     def append(self, entry: bytes, truncate_to: bool = False, on_durable=None) -> None:
         if truncate_to:
             self._backing.clear()
         self._backing.append(entry)
         self.fsync_count += 1
+        if self._tracer is not None and self._tracer.enabled:
+            # Per-append fsync semantics: same instants the real WAL emits.
+            self._tracer.instant(
+                "wal", "wal.append", bytes=len(entry), truncate=truncate_to
+            )
+            self._tracer.instant("wal", "wal.fsync", records=1)
         if on_durable is not None:
             on_durable()  # memory-backed: "durable" immediately
 
@@ -130,13 +140,21 @@ class DeferredMemWAL(WriteAheadLog):
         #: MetricsConsensus bundle for the coalescing-ratio gauge (the
         #: facade wires this like the real WAL's attach_consensus_metrics).
         self._consensus_metrics = None
+        self._tracer = None
 
     def attach_consensus_metrics(self, metrics) -> None:
         self._consensus_metrics = metrics
 
+    def attach_tracer(self, tracer) -> None:
+        self._tracer = tracer
+
     def append(self, entry: bytes, truncate_to: bool = False, on_durable=None) -> None:
         if self._dead:
             return
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant(
+                "wal", "wal.append", bytes=len(entry), truncate=truncate_to
+            )
         self._pending.append((entry, truncate_to, on_durable))
         if self._timer is None:
             self._timer = self._sched.call_later(
@@ -156,6 +174,8 @@ class DeferredMemWAL(WriteAheadLog):
             self.fsync_count += 1
             if self._consensus_metrics is not None:
                 self._consensus_metrics.wal_records_per_fsync.set(len(pending))
+            if self._tracer is not None and self._tracer.enabled:
+                self._tracer.instant("wal", "wal.fsync", records=len(pending))
         for _, _, on_durable in pending:
             if on_durable is not None:
                 on_durable()
@@ -308,6 +328,8 @@ class Node:
         self.fault_plan = plan
         if self.wal is not None:
             self.wal.fault_plan = plan
+        if self.consensus is not None:
+            plan.tracer = self.consensus.tracer
         if isinstance(self.synchronizer, LedgerSynchronizer):
             self.synchronizer.fault_plan = plan
             self.synchronizer.transport.fault_plan = plan
@@ -380,6 +402,10 @@ class Node:
             last_signatures=last.signatures if last else (),
             metrics=self.metrics,
         )
+        if self.fault_plan is not None:
+            # A plan armed before (re)start binds to the fresh tracer so a
+            # crash-matrix trace records exactly which seam fired.
+            self.fault_plan.tracer = self.consensus.tracer
         self.consensus.start()
         self.running = True
 

@@ -160,6 +160,10 @@ class FaultPlan:
         self.dead = False
         #: (point, hit_number) once the plan has fired.
         self.fired: Optional[tuple[str, int]] = None
+        #: Optional decision-lifecycle tracer (trace.Tracer): the armed hit
+        #: emits a ``fault.fired`` instant so a trace shows exactly which
+        #: seam fired, on the same deterministic clock as the phase spans.
+        self.tracer = None
         self._lock = threading.Lock()
 
     def trip(self, point: str) -> bool:
@@ -171,9 +175,14 @@ class FaultPlan:
             n = self.hits[point]
             if self.dead or self.fired is not None:
                 return False
-            if point == self.crash_at and n == self.on_hit:
+            armed = point == self.crash_at and n == self.on_hit
+            if armed:
                 self.fired = (point, n)
-                return True
+        if armed:
+            tracer = self.tracer
+            if tracer is not None and tracer.enabled:
+                tracer.instant("fault", "fault.fired", point=point, hit=n)
+            return True
         return False
 
     def will_fire(self, point: str) -> bool:

@@ -152,6 +152,8 @@ class WriteAheadLog:
         self._records_since_fsync = 0
         #: Optional MetricsConsensus bundle for the coalescing-ratio gauge.
         self._consensus_metrics = None
+        #: Optional decision-lifecycle tracer (trace.Tracer); None when off.
+        self._tracer = None
         #: Entries found by :func:`open_`'s validation scan (None for a
         #: freshly created log) — lets boot avoid a second full-disk read.
         self.entries_at_open: Optional[list[bytes]] = None
@@ -162,11 +164,21 @@ class WriteAheadLog:
         bundle on every data fsync."""
         self._consensus_metrics = metrics
 
+    def attach_tracer(self, tracer) -> None:
+        """Emit ``wal.append``/``wal.fsync`` instants into a decision
+        tracer; the fsync instant carries the same records-per-fsync value
+        the ``consensus_wal_records_per_fsync`` gauge publishes."""
+        self._tracer = tracer
+
     def _count_fsync(self) -> None:
         self.fsync_count += 1
         if self._consensus_metrics is not None and self._records_since_fsync:
             self._consensus_metrics.wal_records_per_fsync.set(
                 self._records_since_fsync
+            )
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant(
+                "wal", "wal.fsync", records=self._records_since_fsync
             )
         self._records_since_fsync = 0
 
@@ -267,6 +279,10 @@ class WriteAheadLog:
         plan = self.fault_plan
         if plan is not None:
             plan.crash("wal.append.pre_write")
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant(
+                "wal", "wal.append", bytes=len(data), truncate=truncate_to
+            )
         flags = _FLAG_TRUNCATE_TO if truncate_to else 0
         self._write_record(_TYPE_ENTRY, flags, data)
         if on_durable is not None and self._group_window:

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Lint: no wall-clock reads inside consensus_tpu/ outside the scheduler.
+
+Determinism (and therefore replayable traces, reproducible crash matrices,
+and byte-identical exported span streams) depends on every timestamp in the
+protocol coming from the injected Scheduler clock.  This script walks the
+package AST and fails on any *call* to:
+
+  - ``time.time()``
+  - ``time.monotonic()``
+  - ``datetime.now()`` / ``datetime.datetime.now()`` with no tz argument
+    is also flagged WITH arguments — naive or aware, it is still wall clock
+
+plus the same functions reached through ``from time import ...`` aliases.
+
+Exemptions:
+
+  - ``consensus_tpu/runtime/scheduler.py`` — the one module allowed to read
+    real time (RealtimeScheduler wraps it behind the Scheduler port).
+  - Any line carrying a ``# wallclock-ok`` comment — for real-thread I/O
+    deadlines that genuinely live outside the simulated clock (sidecar
+    socket waits, device-probe rate limits).  Each such line is an audited
+    exception, greppable by that marker.
+
+References to the functions (e.g. ``now: Callable = time.monotonic`` as an
+injectable default) are fine — only calling them from protocol code is a
+bug.  ``time.sleep`` is not flagged: blocking is a liveness concern, not a
+determinism leak.
+
+Exit status: 0 clean, 1 with an offender list on stdout.  Run as a tier-1
+test via tests/test_no_wallclock.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+#: (module, attribute) pairs whose *call* is forbidden.
+_FORBIDDEN_ATTRS = {
+    ("time", "time"),
+    ("time", "monotonic"),
+    ("datetime", "now"),  # datetime.now(...) via `from datetime import datetime`
+}
+#: Bare names forbidden when imported via ``from time import ...``.
+_FORBIDDEN_FROM_TIME = {"time", "monotonic"}
+
+_EXEMPT_FILES = {os.path.join("runtime", "scheduler.py")}
+_MARKER = "# wallclock-ok"
+
+
+def _call_offense(
+    node: ast.Call, from_time_aliases: set, datetime_mod_aliases: set
+) -> str | None:
+    """Name of the forbidden function this Call invokes, or None."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        # time.time() / time.monotonic() / datetime.now() /
+        # datetime.datetime.now() (module possibly import-aliased)
+        attr = fn.attr
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if (base.id, attr) in _FORBIDDEN_ATTRS:
+                return f"{base.id}.{attr}()"
+        elif isinstance(base, ast.Attribute):
+            if (
+                attr == "now"
+                and base.attr == "datetime"
+                and isinstance(base.value, ast.Name)
+                and base.value.id in datetime_mod_aliases
+            ):
+                return f"{base.value.id}.datetime.now()"
+    elif isinstance(fn, ast.Name) and fn.id in from_time_aliases:
+        return f"{fn.id}()  [from time import]"
+    return None
+
+
+def _import_aliases(tree: ast.AST) -> tuple[set, set]:
+    """(names bound by `from time import time/monotonic`, names the datetime
+    MODULE is imported as)."""
+    from_time = set()
+    datetime_mod = {"datetime"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in _FORBIDDEN_FROM_TIME:
+                    from_time.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "datetime":
+                    datetime_mod.add(alias.asname or alias.name)
+    return from_time, datetime_mod
+
+
+def check_file(path: str, rel: str) -> list[str]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as exc:  # a broken file is its own tier-1 failure
+        return [f"{rel}:{exc.lineno}: syntax error: {exc.msg}"]
+    lines = source.splitlines()
+    from_time_aliases, datetime_mod_aliases = _import_aliases(tree)
+    offenses = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_offense(node, from_time_aliases, datetime_mod_aliases)
+        if name is None:
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _MARKER in line:
+            continue
+        offenses.append(f"{rel}:{node.lineno}: {name}")
+    return offenses
+
+
+def main(argv: list[str]) -> int:
+    root = (
+        argv[1]
+        if len(argv) > 1
+        else os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "consensus_tpu")
+    )
+    offenses: list[str] = []
+    for dirpath, _dirnames, filenames in sorted(os.walk(root)):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel in _EXEMPT_FILES:
+                continue
+            offenses.extend(check_file(path, rel))
+    if offenses:
+        print("wall-clock reads outside runtime/scheduler.py "
+              "(mark audited real-thread deadlines with '# wallclock-ok'):")
+        for off in offenses:
+            print(f"  {off}")
+        return 1
+    print("no wall-clock reads outside runtime/scheduler.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

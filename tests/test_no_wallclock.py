@@ -1,0 +1,55 @@
+"""Tier-1 gate: no wall-clock reads in consensus_tpu/ outside the scheduler.
+
+Every protocol timestamp must come from the injected Scheduler clock —
+that's what makes SimScheduler replays (and therefore exported trace
+streams, crash matrices, and the pipelining tests) bit-identical run to
+run.  scripts/check_no_wallclock.py is the AST lint; this test wires it
+into the tier-1 suite so a stray ``time.time()`` fails CI, not a code
+review.
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "scripts", "check_no_wallclock.py")
+
+
+def test_no_wallclock_reads_outside_scheduler():
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT],
+        capture_output=True,
+        text=True,
+        cwd=_REPO,
+    )
+    assert proc.returncode == 0, (
+        "wall-clock lint failed:\n" + proc.stdout + proc.stderr
+    )
+
+
+def test_lint_catches_a_violation(tmp_path):
+    """The gate itself must be live: a synthetic offender tree fails."""
+    (tmp_path / "bad.py").write_text(
+        "import time\nx = time.time()\n", encoding="utf-8"
+    )
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(tmp_path)],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 1
+    assert "bad.py:2: time.time()" in proc.stdout
+
+
+def test_lint_honors_wallclock_ok_marker(tmp_path):
+    (tmp_path / "audited.py").write_text(
+        "import time\ndeadline = time.monotonic()  # wallclock-ok\n",
+        encoding="utf-8",
+    )
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, str(tmp_path)],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout
