@@ -54,6 +54,17 @@ from consensus_tpu.wire import (
 
 logger = logging.getLogger("consensus_tpu.view")
 
+#: TEST-ONLY sentinel (chaos-engine end-to-end validation;
+#: tests/test_chaos_engine.py): when flipped, any view installed by a view
+#: change (number > 0) collects only a SINGLE peer commit before deciding —
+#: a deliberately mis-wired quorum check.  The delivered decision then
+#: carries fewer than ``2f + 1`` consenter signatures, which the invariant
+#: monitor's commit-implies-quorum-cert check must flag AT DELIVERY TIME,
+#: and the delta-debugging shrinker must reduce any failing schedule down
+#: to the disruptive action(s) that forced the view change.  Never set
+#: outside tests; production constructors cannot reach it.
+SENTINEL_MISWIRED_QUORUM = False
+
 
 class Phase(IntEnum):
     """Parity: reference internal/bft/view.go:23-46."""
@@ -847,6 +858,8 @@ class View:
     def _try_process_commits(self) -> None:
         assert self.in_flight_proposal is not None
         needed = self.quorum - 1
+        if SENTINEL_MISWIRED_QUORUM and self.number > 0:
+            needed = 1  # test-only mis-wiring; see the module-level sentinel
         if len(self._valid_commit_sigs) < needed:
             self._batch_verify_pending_commits(needed)
         if len(self._valid_commit_sigs) < needed:

@@ -23,6 +23,20 @@ from typing import Optional, Sequence
 VERIFY_LAUNCH_BATCH_KEY = "consensus_cross_slot_verify_batch"
 WAL_RECORDS_PER_FSYNC_KEY = "consensus_wal_records_per_fsync"
 
+#: Pinned instrument names for INJECTED network adversary events (the chaos
+#: engine's SimNetwork primitives: loss/mutate/filter drops, duplication,
+#: reordering, stale replay — consensus_tpu/testing/network.py).  The
+#: network tracer mirrors each as a ``net.<kind>`` instant; the parity test
+#: (tests/test_trace.py) holds counter and instant streams equal.  Order
+#: matches network.INJECTED_EVENT_KINDS.
+NET_DROPPED_KEY = "net_injected_dropped"
+NET_DUPLICATED_KEY = "net_injected_duplicated"
+NET_REORDERED_KEY = "net_injected_reordered"
+NET_REPLAYED_KEY = "net_injected_replayed"
+NET_INJECTED_KEYS = (
+    NET_DROPPED_KEY, NET_DUPLICATED_KEY, NET_REORDERED_KEY, NET_REPLAYED_KEY,
+)
+
 
 class Counter(abc.ABC):
     @abc.abstractmethod
@@ -374,6 +388,30 @@ class MetricsSync(_Bundle):
         )
 
 
+class MetricsNetwork(_Bundle):
+    """Injected network adversary events — consensus_tpu addition, fed by
+    ``SimNetwork`` (testing/network.py) when a bundle is attached, so chaos
+    runs are attributable: how much of the schedule's adversary budget
+    actually landed on the wire."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_dropped = p.new_counter(
+            NET_DROPPED_KEY,
+            "Messages dropped by injection (loss rolls, mutate/filter drops).",
+            ln,
+        )
+        self.count_duplicated = p.new_counter(
+            NET_DUPLICATED_KEY, "Messages delivered twice by injection.", ln
+        )
+        self.count_reordered = p.new_counter(
+            NET_REORDERED_KEY, "Messages held back past later sends.", ln
+        )
+        self.count_replayed = p.new_counter(
+            NET_REPLAYED_KEY, "Stale captured messages re-delivered.", ln
+        )
+
+
 class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
@@ -409,6 +447,7 @@ class Metrics:
         self.view_change = MetricsViewChange(provider, label_names)
         self.wal = MetricsWAL(provider, label_names)
         self.sync = MetricsSync(provider, label_names)
+        self.network = MetricsNetwork(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
         """Bind embedder label values on every bundle (e.g. the channel id).
@@ -439,7 +478,13 @@ __all__ = [
     "MetricsViewChange",
     "MetricsWAL",
     "MetricsSync",
+    "MetricsNetwork",
     "extend_label_names",
     "VERIFY_LAUNCH_BATCH_KEY",
     "WAL_RECORDS_PER_FSYNC_KEY",
+    "NET_DROPPED_KEY",
+    "NET_DUPLICATED_KEY",
+    "NET_REORDERED_KEY",
+    "NET_REPLAYED_KEY",
+    "NET_INJECTED_KEYS",
 ]

@@ -14,6 +14,14 @@ from consensus_tpu.testing.app import (
     pack_batch,
     unpack_batch,
 )
+from consensus_tpu.testing.chaos import (
+    ChaosAction,
+    ChaosEngine,
+    ChaosResult,
+    ChaosSchedule,
+    format_repro,
+    shrink,
+)
 from consensus_tpu.testing.crypto_app import ClientKeyring, CryptoApp, SignedRequestApp
 from consensus_tpu.testing.faults import (
     CRASH_POINTS,
@@ -22,9 +30,26 @@ from consensus_tpu.testing.faults import (
     SimulatedCrash,
     registered_crash_points,
 )
-from consensus_tpu.testing.network import NodeComm, SimNetwork
+from consensus_tpu.testing.invariants import (
+    InvariantMonitor,
+    InvariantViolation,
+    Violation,
+    is_known_unresolvable_split,
+)
+from consensus_tpu.testing.network import INJECTED_EVENT_KINDS, NodeComm, SimNetwork
 
 __all__ = [
+    "ChaosAction",
+    "ChaosEngine",
+    "ChaosResult",
+    "ChaosSchedule",
+    "format_repro",
+    "shrink",
+    "InvariantMonitor",
+    "InvariantViolation",
+    "Violation",
+    "is_known_unresolvable_split",
+    "INJECTED_EVENT_KINDS",
     "CRASH_POINTS",
     "FaultPlan",
     "InjectedIOError",

@@ -212,6 +212,13 @@ class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
     def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
         decision = Decision(proposal=proposal, signatures=tuple(signatures))
         self.ledger.append(decision)
+        # Commit-path delivery hooks (the chaos invariant monitor lives
+        # here): called AFTER the append so a hook sees the ledger it is
+        # judging.  Sync/catch-up appends bypass deliver() — hooks observe
+        # only decisions this replica committed itself.  getattr: several
+        # tests duck-type `cluster` with minimal stubs.
+        for hook in getattr(self.cluster, "delivery_hooks", ()):
+            hook(self.node_id, decision)
         return self.cluster.reconfig_of(proposal)
 
     # Assembler
@@ -480,6 +487,9 @@ class Cluster:
         self.network = SimNetwork(self.scheduler, seed=seed)
         self.network.membership = list(range(1, n + 1))
         self.nodes: dict[int, Node] = {}
+        #: fn(node_id, Decision) called on every COMMIT-PATH delivery (not
+        #: on sync appends) — the invariant monitor's wiring point.
+        self.delivery_hooks: list = []
         #: proposal-digest -> Reconfig to report on delivery (reconfig tests).
         self._reconfigs: dict[str, Reconfig] = {}
         tweaks = dict(config_tweaks or {})

@@ -1,0 +1,572 @@
+"""Deterministic chaos engine: schedule DSL, executor, and shrinker.
+
+The randomized soak loops (tests/test_soak.py) interleave their adversary
+decisions WITH the run — the ``random.Random`` stream decides each step as
+the cluster evolves, so a failure reproduces only by re-running the whole
+loop, and no part of it can be removed without perturbing everything after
+it.  The chaos engine splits those concerns:
+
+* :class:`ChaosSchedule` — a seed-derived, **sim-clock-anchored** sequence
+  of named adversary actions (:class:`ChaosAction`), generated up front.
+  The schedule IS the adversary: executing the same schedule yields a
+  byte-identical event log and identical final ledgers, and individual
+  actions can be deleted without changing when the survivors fire.
+* :class:`ChaosEngine` — executes a schedule on a fresh
+  :class:`~consensus_tpu.testing.app.Cluster` with an
+  :class:`~consensus_tpu.testing.invariants.InvariantMonitor` wired into
+  the delivery hooks, checking safety AT EVERY DELIVERY and bounded
+  time-to-progress after the last disruptive action.  Violations carry the
+  exact sim-time and the action history that led there.
+* :func:`shrink` — delta-debugging (ddmin) over the action list: given a
+  failing schedule, converge to a minimal action subset that still fails
+  with the SAME invariant, and :func:`format_repro` renders it as a
+  paste-able snippet.
+
+Adversary vocabulary (``ChaosAction.kind``):
+
+``crash`` / ``restart``         process death and recovery (WAL survives)
+``partition`` / ``heal``        link cuts around a group / clear ALL knobs
+``loss`` / ``delay``            per-link probabilistic drop / latency
+``duplicate`` / ``reorder`` / ``replay``
+                                the byzantine-network primitives
+                                (testing/network.py)
+``byzantine`` / ``byzantine_stop``
+                                per-SENDER message mutation (≤ f senders)
+``arm_fault``                   arm a WAL/state/sync crash point from the
+                                FaultPlan catalog (testing/faults.py)
+
+Everything runs on the SimScheduler's virtual clock — no wall-clock reads
+anywhere (scripts/check_no_wallclock.py lints this module too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from consensus_tpu.testing.app import Cluster, make_request
+from consensus_tpu.testing.faults import FaultPlan
+from consensus_tpu.testing.invariants import (
+    InvariantMonitor,
+    Violation,
+    is_known_unresolvable_split,
+)
+from consensus_tpu.utils.quorum import compute_quorum
+
+#: The soak suite's fast-timeout profile; chaos runs use the same one so a
+#: 25-action schedule finishes in well under a sim-hour.
+DEFAULT_TWEAKS = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+#: Crash points the generator arms (all reachable on the in-memory WAL
+#: path; the wal.* points need a file-backed cluster and stay out of the
+#: default vocabulary).
+ARMABLE_POINTS = (
+    "state.save.proposed.pre",
+    "state.save.proposed.post",
+    "state.save.commit.pre",
+    "state.save.commit.post",
+    "state.save.viewchange.post",
+    "state.save.newview.pre",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosAction:
+    """One named adversary action at an absolute sim-time.  The default
+    dataclass repr is deliberately paste-able Python (``args`` is a plain
+    dict literal) — :func:`format_repro` leans on that."""
+
+    at: float
+    kind: str
+    args: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSchedule:
+    """A complete adversary: cluster shape + ordered actions.  Frozen so a
+    schedule can be replayed or shrunk without aliasing surprises."""
+
+    seed: int
+    n: int = 4
+    durability_window: float = 0.0
+    actions: tuple = ()
+
+    @classmethod
+    def generate(
+        cls,
+        seed: int,
+        *,
+        n: int = 4,
+        steps: int = 25,
+        durability_window: float = 0.0,
+        start: float = 30.0,
+    ) -> "ChaosSchedule":
+        """Derive a feasible schedule from ``seed``: action times are
+        cumulative uniform(5, 40) gaps from ``start``, kinds are weighted
+        draws constrained so the adversary stays inside the fault model
+        (≤ f replicas down or doomed at once, ≤ f byzantine senders)."""
+        rng = random.Random(seed)
+        ids = list(range(1, n + 1))
+        _, f = compute_quorum(n)
+        kinds = ["crash", "restart", "partition", "heal", "loss", "delay",
+                 "duplicate", "reorder", "replay", "byzantine",
+                 "byzantine_stop", "arm_fault"]
+        weights = [2.0, 2.0, 1.5, 2.0, 2.0, 1.5, 1.5, 1.5, 1.5, 1.0, 1.0, 1.0]
+        t = start
+        down: set[int] = set()  # crashed or armed-to-crash
+        byzantine: set[int] = set()
+        actions = []
+        for _ in range(steps):
+            t += rng.uniform(5.0, 40.0)
+            kind = rng.choices(kinds, weights)[0]
+            # Feasibility downgrades keep every generated action applicable
+            # (the engine re-checks at run time anyway — shrunk subsets may
+            # still strand a restart whose crash was deleted).
+            if kind in ("crash", "arm_fault") and len(down) >= f:
+                kind = "restart" if down else "heal"
+            if kind == "restart" and not down:
+                kind = "heal"
+            if kind == "byzantine" and len(byzantine) >= max(f, 1):
+                kind = "byzantine_stop"
+            if kind == "byzantine_stop" and not byzantine:
+                kind = "loss"
+
+            if kind == "crash":
+                node = rng.choice([i for i in ids if i not in down])
+                down.add(node)
+                actions.append(ChaosAction(at=t, kind="crash",
+                                           args={"node": node}))
+            elif kind == "restart":
+                node = rng.choice(sorted(down))
+                down.discard(node)
+                actions.append(ChaosAction(at=t, kind="restart",
+                                           args={"node": node}))
+            elif kind == "partition":
+                group = sorted(rng.sample(ids, rng.choice([1, 1, 2])))
+                actions.append(ChaosAction(at=t, kind="partition",
+                                           args={"group": tuple(group)}))
+            elif kind == "heal":
+                actions.append(ChaosAction(at=t, kind="heal"))
+            elif kind in ("loss", "duplicate", "reorder", "replay"):
+                a, b = rng.sample(ids, 2)
+                p = rng.choice([0.1, 0.3, 0.5])
+                actions.append(ChaosAction(at=t, kind=kind,
+                                           args={"a": a, "b": b, "p": p}))
+            elif kind == "delay":
+                a, b = rng.sample(ids, 2)
+                d = round(rng.uniform(0.05, 0.5), 3)
+                actions.append(ChaosAction(at=t, kind="delay",
+                                           args={"a": a, "b": b, "d": d}))
+            elif kind == "byzantine":
+                node = rng.choice([i for i in ids if i not in byzantine])
+                byzantine.add(node)
+                actions.append(ChaosAction(
+                    at=t, kind="byzantine",
+                    args={"node": node, "rate": rng.choice([0.3, 0.7])},
+                ))
+            elif kind == "byzantine_stop":
+                byzantine.clear()
+                actions.append(ChaosAction(at=t, kind="byzantine_stop"))
+            else:  # arm_fault: the armed replica dies at the seam firing
+                node = rng.choice([i for i in ids if i not in down])
+                down.add(node)
+                actions.append(ChaosAction(
+                    at=t, kind="arm_fault",
+                    args={"node": node,
+                          "point": rng.choice(ARMABLE_POINTS),
+                          "hit": rng.randrange(1, 4)},
+                ))
+        return cls(seed=seed, n=n, durability_window=durability_window,
+                   actions=tuple(actions))
+
+
+@dataclasses.dataclass
+class ChaosResult:
+    """Outcome of one engine run.  ``event_log`` is the full deterministic
+    trace of applied actions, violations, and final ledger digests —
+    byte-identical across replays of the same schedule."""
+
+    ok: bool
+    violation: Optional[Violation]
+    event_log: bytes
+    ledgers: dict
+    schedule: ChaosSchedule
+    deliveries: int
+
+
+class ChaosEngine:
+    """Executes one :class:`ChaosSchedule` to a :class:`ChaosResult`."""
+
+    #: Requests submitted alongside each applied action / at warmup / at
+    #: the final progress probe.
+    REQUESTS_PER_ACTION = 2
+    WARMUP_REQUESTS = 4
+    PROBE_REQUESTS = 5
+    WARMUP_BUDGET = 300.0
+    SETTLE_TIME = 60.0
+    #: Bounded time-to-progress after the last disruptive action: n - f
+    #: replicas must extend the ledger within this much sim-time of the
+    #: post-schedule heal (the liveness invariant's budget).
+    LIVENESS_BUDGET = 900.0
+
+    def __init__(
+        self,
+        schedule: ChaosSchedule,
+        *,
+        config_tweaks: Optional[dict] = None,
+        check_durability: bool = True,
+        metrics=None,
+        tracer=None,
+    ) -> None:
+        self.schedule = schedule
+        self.config_tweaks = dict(config_tweaks or DEFAULT_TWEAKS)
+        self.check_durability = check_durability
+        self.metrics = metrics
+        self.tracer = tracer
+        self.cluster: Optional[Cluster] = None
+        self.monitor: Optional[InvariantMonitor] = None
+        self._log: list[str] = []
+        self._submitted = 0
+        self._byz_rules: dict[int, float] = {}
+        #: Engine-owned mutation stream, independent of the network's RNG
+        #: so arming byzantine mid-run cannot shift loss/duplicate rolls.
+        self._byz_rng = random.Random(schedule.seed ^ 0xB12A)
+
+    # --- bookkeeping --------------------------------------------------------
+
+    def _emit(self, line: str) -> None:
+        self._log.append(line)
+        self.monitor.history.append(line)
+
+    def _now(self) -> float:
+        return self.cluster.scheduler.now()
+
+    def _submit(self, k: int) -> None:
+        for _ in range(k):
+            self.cluster.submit_to_all(make_request("chaos", self._submitted))
+            self._submitted += 1
+
+    def _fmt_args(self, action: ChaosAction) -> str:
+        return " ".join(f"{k}={v!r}" for k, v in sorted(action.args.items()))
+
+    # --- the adversary actions ---------------------------------------------
+
+    def _apply(self, action: ChaosAction) -> bool:
+        """Apply one action if currently feasible; False means skipped
+        (shrunk subsets legitimately strand restarts and byzantine_stops)."""
+        net = self.cluster.network
+        nodes = self.cluster.nodes
+        _, f = compute_quorum(self.schedule.n)
+        dead = sum(1 for nd in nodes.values() if not nd.running)
+        kind, args = action.kind, action.args
+        if kind == "crash":
+            node = nodes[args["node"]]
+            if not node.running or dead >= f:
+                return False
+            node.crash()
+            return True
+        if kind == "restart":
+            node = nodes[args["node"]]
+            if node.running:
+                return False
+            node.restart()
+            return True
+        if kind == "partition":
+            net.partition(list(args["group"]))
+            return True
+        if kind == "heal":
+            net.heal()
+            return True
+        if kind == "loss":
+            net.set_loss(args["a"], args["b"], args["p"])
+            return True
+        if kind == "delay":
+            net.set_delay(args["a"], args["b"], args["d"])
+            return True
+        if kind == "duplicate":
+            net.set_duplicate(args["a"], args["b"], args["p"])
+            return True
+        if kind == "reorder":
+            net.set_reorder(args["a"], args["b"], args["p"])
+            return True
+        if kind == "replay":
+            net.set_replay(args["a"], args["b"], args["p"])
+            return True
+        if kind == "byzantine":
+            if (args["node"] not in self._byz_rules
+                    and len(self._byz_rules) >= max(f, 1)):
+                return False
+            self._byz_rules[args["node"]] = args["rate"]
+            net.mutate_send = self._mutate
+            return True
+        if kind == "byzantine_stop":
+            if not self._byz_rules:
+                return False
+            self._byz_rules.clear()
+            return True
+        if kind == "arm_fault":
+            node = nodes[args["node"]]
+            if not node.running or node.fault_plan is not None or dead >= f:
+                return False
+            plan = FaultPlan(args["point"], on_hit=args["hit"],
+                             label=f"chaos@{action.at:.4f}")
+            node.arm_fault_plan(plan)
+            return True
+        raise ValueError(f"unknown chaos action kind {kind!r}")
+
+    def _mutate(self, sender: int, target: int, msg):
+        """Byzantine-SENDER mutation: messages from an armed sender are
+        corrupted at its configured rate.  Validation must shed all of it;
+        ≤ f armed senders keeps this inside the threat model."""
+        rate = self._byz_rules.get(sender)
+        if not rate or self._byz_rng.random() >= rate:
+            return msg
+        roll = self._byz_rng.random()
+        digest = getattr(msg, "digest", None)
+        if isinstance(digest, str) and roll < 0.4:
+            return dataclasses.replace(msg, digest="byz-" + digest[:8])
+        view = getattr(msg, "view", None)
+        if isinstance(view, int) and roll < 0.7:
+            return dataclasses.replace(
+                msg, view=view + 1 + self._byz_rng.randrange(3)
+            )
+        seq = getattr(msg, "seq", None)
+        if isinstance(seq, int):
+            return dataclasses.replace(
+                msg, seq=max(0, seq + self._byz_rng.choice([-1, 1, 5]))
+            )
+        return msg
+
+    def _disarm_faults(self) -> None:
+        for node in self.cluster.nodes.values():
+            node.fault_plan = None
+            if node.wal is not None:
+                node.wal.fault_plan = None
+            sync = node.synchronizer
+            if sync is not None and hasattr(sync, "fault_plan"):
+                sync.fault_plan = None
+                sync.transport.fault_plan = None
+
+    # --- the run ------------------------------------------------------------
+
+    def run(self) -> ChaosResult:
+        sched = self.schedule
+        self.cluster = Cluster(
+            sched.n,
+            seed=sched.seed ^ 0xCA05,
+            config_tweaks=self.config_tweaks,
+            durability_window=sched.durability_window,
+        )
+        if self.metrics is not None:
+            self.cluster.network.metrics = self.metrics.network
+        if self.tracer is not None:
+            self.cluster.network.tracer = self.tracer
+        self.monitor = InvariantMonitor(
+            self.cluster, check_durability=self.check_durability
+        )
+        self.cluster.start()
+        self._emit(f"{self._now():10.4f} start n={sched.n} seed={sched.seed} "
+                   f"window={sched.durability_window!r}")
+
+        # Warm up: the cluster must order a block before the adversary acts.
+        self._submit(self.WARMUP_REQUESTS)
+        if not self.cluster.run_until_ledger(1, max_time=self.WARMUP_BUDGET):
+            self.monitor.record(
+                "liveness", None,
+                f"no block ordered within {self.WARMUP_BUDGET}s sim-time "
+                "BEFORE any adversary action",
+            )
+        self._emit(f"{self._now():10.4f} warmup done")
+
+        for action in sched.actions:
+            if self.monitor.violations:
+                break
+            gap = action.at - self._now()
+            if gap > 0:
+                self.cluster.scheduler.advance(gap)
+            if self.monitor.violations:
+                break
+            applied = self._apply(action)
+            self._emit(
+                f"{self._now():10.4f} "
+                f"{'apply' if applied else 'skip '} "
+                f"{action.kind} {self._fmt_args(action)}".rstrip()
+            )
+            self._submit(self.REQUESTS_PER_ACTION)
+
+        if not self.monitor.violations:
+            # Quiesce: adversary off, everyone back, then LIVENESS — n - f
+            # replicas must make progress within the budget.
+            self.cluster.network.heal()
+            self.cluster.network.mutate_send = None
+            self._byz_rules.clear()
+            self._disarm_faults()
+            for node in self.cluster.nodes.values():
+                if not node.running:
+                    node.restart()
+            self._emit(f"{self._now():10.4f} quiesce: healed + restarted")
+            self.cluster.scheduler.advance(self.SETTLE_TIME)
+            _, f = compute_quorum(sched.n)
+            floor = max(
+                len(nd.app.ledger) for nd in self.cluster.nodes.values()
+            )
+            self._submit(self.PROBE_REQUESTS)
+            target = floor + 1
+            progressed = self.cluster.scheduler.run_until(
+                lambda: sum(
+                    1 for nd in self.cluster.nodes.values()
+                    if len(nd.app.ledger) >= target
+                ) >= sched.n - f,
+                max_time=self.LIVENESS_BUDGET,
+            )
+            if not progressed and not is_known_unresolvable_split(
+                self.cluster, sched.n
+            ):
+                self.monitor.record(
+                    "liveness", None,
+                    f"{sched.n - f} replicas failed to reach height {target} "
+                    f"within {self.LIVENESS_BUDGET}s sim-time of the final "
+                    "heal (and the stall is not a known-unresolvable "
+                    "prepared split)",
+                )
+            self.monitor._check_prefix_agreement()
+
+        violation = self.monitor.first
+        if violation is not None:
+            self._emit(
+                f"{violation.sim_time:10.4f} VIOLATION {violation.invariant}: "
+                f"{violation.detail}"
+            )
+        ledgers = {
+            nid: tuple(d.proposal.digest() for d in node.app.ledger)
+            for nid, node in sorted(self.cluster.nodes.items())
+        }
+        for nid, digests in ledgers.items():
+            tail = ",".join(digests[-3:])
+            self._emit(f"{self._now():10.4f} ledger {nid} "
+                       f"height={len(digests)} tail={tail}")
+        return ChaosResult(
+            ok=violation is None,
+            violation=violation,
+            event_log="\n".join(self._log).encode() + b"\n",
+            ledgers=ledgers,
+            schedule=sched,
+            deliveries=self.monitor.deliveries,
+        )
+
+
+# --- shrinking -------------------------------------------------------------
+
+
+def _run_subset(schedule: ChaosSchedule, actions, engine_kwargs) -> ChaosResult:
+    sub = dataclasses.replace(schedule, actions=tuple(actions))
+    return ChaosEngine(sub, **engine_kwargs).run()
+
+
+def shrink(
+    schedule: ChaosSchedule,
+    *,
+    invariant: Optional[str] = None,
+    engine_kwargs: Optional[dict] = None,
+    max_runs: int = 200,
+) -> tuple[ChaosSchedule, ChaosResult]:
+    """Delta-debug (ddmin) a failing schedule down to a minimal action
+    subset that still violates the SAME invariant.
+
+    ``invariant`` defaults to whatever the full schedule violates (the
+    full run happens first either way, to anchor the target); shrinking a
+    passing schedule raises.  ``max_runs`` bounds the engine executions —
+    each is a full deterministic sim, so this is a time cap, not a
+    correctness knob.  Returns ``(shrunk_schedule, failing_result)``."""
+    kwargs = dict(engine_kwargs or {})
+    runs = [0]
+
+    def failing(actions) -> Optional[ChaosResult]:
+        if runs[0] >= max_runs:
+            return None
+        runs[0] += 1
+        res = _run_subset(schedule, actions, kwargs)
+        if res.violation is not None and (
+            invariant is None or res.violation.invariant == invariant
+        ):
+            return res
+        return None
+
+    best_res = failing(schedule.actions)
+    if best_res is None:
+        raise ValueError(
+            "schedule does not fail"
+            + (f" with invariant {invariant!r}" if invariant else "")
+            + " — nothing to shrink"
+        )
+    if invariant is None:
+        invariant = best_res.violation.invariant
+    best = list(schedule.actions)
+
+    granularity = 2
+    while len(best) >= 2:
+        chunk = max(1, len(best) // granularity)
+        reduced = False
+        i = 0
+        while i < len(best):
+            candidate = best[:i] + best[i + chunk:]  # drop one chunk
+            res = failing(candidate)
+            if res is not None:
+                best, best_res = candidate, res
+                granularity = max(granularity - 1, 2)
+                reduced = True
+            else:
+                i += chunk
+        if not reduced:
+            if granularity >= len(best):
+                break
+            granularity = min(len(best), granularity * 2)
+        if runs[0] >= max_runs:
+            break
+    return dataclasses.replace(schedule, actions=tuple(best)), best_res
+
+
+def format_repro(result: ChaosResult) -> str:
+    """A paste-able snippet reproducing ``result``'s schedule byte-for-byte
+    (drop it in a test or a REPL; the engine is fully deterministic)."""
+    s = result.schedule
+    lines = [
+        "from consensus_tpu.testing.chaos import (",
+        "    ChaosAction, ChaosEngine, ChaosSchedule,",
+        ")",
+        "",
+        "schedule = ChaosSchedule(",
+        f"    seed={s.seed!r},",
+        f"    n={s.n!r},",
+        f"    durability_window={s.durability_window!r},",
+        "    actions=(",
+    ]
+    for a in s.actions:
+        lines.append(f"        {a!r},")
+    lines += [
+        "    ),",
+        ")",
+        "result = ChaosEngine(schedule).run()",
+        "print(result.violation or 'run is clean')",
+    ]
+    return "\n".join(lines)
+
+
+__all__ = [
+    "ARMABLE_POINTS",
+    "ChaosAction",
+    "ChaosEngine",
+    "ChaosResult",
+    "ChaosSchedule",
+    "DEFAULT_TWEAKS",
+    "format_repro",
+    "shrink",
+]

@@ -166,24 +166,38 @@ class FaultPlan:
         self.tracer = None
         self._lock = threading.Lock()
 
-    def trip(self, point: str) -> bool:
-        """Count one hit of ``point``; True when this hit is the armed one."""
+    def _count_hit(self, point: str, *, die: bool) -> tuple[bool, int]:
+        """One atomic hit of ``point``: count it and, when this hit is the
+        armed one, mark it fired (and dead, for crash seams) in the SAME
+        critical section — transport/sidecar seams race the consensus
+        thread, and a dead-check outside the lock lets two threads both
+        observe the firing (or a zombie slip one last effect through).
+        Returns ``(armed, hit_number)``; ``hit_number`` is 0 when the call
+        was a zombie touch (``die`` and already dead)."""
         if point not in CRASH_POINTS:
             raise ValueError(f"seam reports unregistered crash point {point!r}")
         with self._lock:
+            if die and self.dead:
+                return False, 0
             self.hits[point] += 1
             n = self.hits[point]
             if self.dead or self.fired is not None:
-                return False
+                return False, n
             armed = point == self.crash_at and n == self.on_hit
             if armed:
                 self.fired = (point, n)
+                if die:
+                    self.dead = True
         if armed:
             tracer = self.tracer
             if tracer is not None and tracer.enabled:
                 tracer.instant("fault", "fault.fired", point=point, hit=n)
-            return True
-        return False
+        return armed, n
+
+    def trip(self, point: str) -> bool:
+        """Count one hit of ``point``; True when this hit is the armed one."""
+        armed, _ = self._count_hit(point, die=False)
+        return armed
 
     def will_fire(self, point: str) -> bool:
         """Whether the NEXT hit of ``point`` would fire (peek, no count) —
@@ -197,11 +211,14 @@ class FaultPlan:
             )
 
     def crash(self, point: str) -> None:
-        """Crash-type seam: die here when armed; zombie frames die again."""
-        if self.dead:
+        """Crash-type seam: die here when armed; zombie frames die again.
+        The dead-check, hit count, and dead-set are one atomic step
+        (:meth:`_count_hit`), so concurrent seam threads agree on exactly
+        one firing and no post-death touch slips through."""
+        armed, n = self._count_hit(point, die=True)
+        if n == 0:
             raise SimulatedCrash(f"zombie process touched {point}")
-        if self.trip(point):
-            self.dead = True
+        if armed:
             if self.on_crash is not None:
                 self.on_crash()
             raise SimulatedCrash(

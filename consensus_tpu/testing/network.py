@@ -10,20 +10,46 @@ fault-injection knobs mirror the reference:
   reference test/test_app.go:180-191)
 * receiver-side selective filters (``lose_messages``)
 * per-link latency (``set_delay``)
+
+plus the byzantine-NETWORK primitives the reference harness lacks (the
+chaos engine's adversary vocabulary, consensus_tpu/testing/chaos.py):
+
+* probabilistic duplication (``set_duplicate``) — the same signed message
+  delivered twice,
+* probabilistic reordering (``set_reorder``) — a message overtaken by
+  later sends on the same link,
+* stale replay (``set_replay``) — an OLD captured message re-delivered
+  long after it was first sent (the baseline adversary for signed-message
+  protocols; arXiv:2302.00418 §2).
+
+Every injected event (a loss-roll drop, a mutate/filter drop, a duplicate,
+a reorder, a replay) is counted in :attr:`SimNetwork.injected`, mirrored
+into an attached ``MetricsNetwork`` bundle (``attach_metrics``) and, when a
+tracer is attached, emitted as ``net.<event>`` instants on the shared sim
+clock — so a chaos run's adversary activity is attributable in the same
+trace as the protocol's phase spans.
 """
 
 from __future__ import annotations
 
 import random
+from collections import Counter, deque
 from typing import Callable, Optional, Sequence
 
 from consensus_tpu.api.deps import Comm
 from consensus_tpu.runtime.scheduler import SimScheduler
 from consensus_tpu.wire import ConsensusMessage
 
+#: The injected-event kinds :attr:`SimNetwork.injected` counts, in the
+#: order the metrics bundle pins them (metrics.py NET_INJECTED_KEYS).
+INJECTED_EVENT_KINDS = ("dropped", "duplicated", "reordered", "replayed")
+
 
 class SimNetwork:
     """Routes messages between registered replicas over the shared clock."""
+
+    #: Captured messages kept per replay-armed link (oldest evicted first).
+    REPLAY_BUFFER_DEPTH = 32
 
     def __init__(self, scheduler: SimScheduler, *, seed: int = 0, default_delay: float = 0.001) -> None:
         self.scheduler = scheduler
@@ -37,10 +63,27 @@ class SimNetwork:
         self._cut_links: set[tuple[int, int]] = set()
         self._loss: dict[tuple[int, int], float] = {}
         self._delay: dict[tuple[int, int], float] = {}
+        self._duplicate: dict[tuple[int, int], float] = {}
+        self._reorder: dict[tuple[int, int], float] = {}
+        self._replay: dict[tuple[int, int], float] = {}
+        #: (a, b) -> deque of stale (payload, is_request) captures for links
+        #: with replay armed.
+        self._replay_buffers: dict[tuple[int, int], deque] = {}
         #: fn(sender, target, msg) -> msg | None (None drops the message).
         self.mutate_send: Optional[Callable[[int, int, object], Optional[object]]] = None
         #: fn(target, sender, msg) -> bool; True drops at the receiver.
         self.lose_messages: Optional[Callable[[int, int, object], bool]] = None
+        #: Injected adversary events: dropped / duplicated / reordered /
+        #: replayed.  "dropped" counts only *injected* drops (loss rolls,
+        #: mutate_send returning None, lose_messages filtering) — cuts,
+        #: partitions, and dead endpoints are topology, not per-message
+        #: injection.
+        self.injected: Counter = Counter()
+        #: Optional MetricsNetwork bundle mirroring :attr:`injected`.
+        self.metrics = None
+        #: Optional trace.Tracer: injected events become ``net.<kind>``
+        #: instants on the shared sim clock.
+        self.tracer = None
 
     # --- membership --------------------------------------------------------
 
@@ -76,7 +119,17 @@ class SimNetwork:
         self._cut_links.discard((b, a))
 
     def partition(self, group: Sequence[int]) -> None:
-        """Cut every link crossing the boundary of ``group``."""
+        """Cut every link crossing the boundary of ``group``.
+
+        NOTE for direct users (Cluster sets this up for you): the boundary
+        is computed over :meth:`node_ids`, which without ``membership``
+        falls back to the *live registration set* — a node that is crashed
+        (unregistered) when ``partition`` is called gets NO cut links, so
+        the partition silently leaks around it once it restarts.  Set
+        ``membership`` to the full configured id set before partitioning
+        around crashes (pinned by
+        tests/test_network_adversary.py::test_partition_leaks_around_crashed_node_without_membership).
+        """
         inside = set(group)
         for a in self.node_ids():
             for b in self.node_ids():
@@ -84,13 +137,39 @@ class SimNetwork:
                     self._cut_links.add((a, b))
 
     def heal(self) -> None:
+        """Clear every fault knob: cuts, disconnections, loss, per-link
+        delay overrides, duplication, reordering, and replay (stale capture
+        buffers included — a healed network holds no adversary state)."""
         self._cut_links.clear()
         self._disconnected.clear()
         self._loss.clear()
+        self._delay.clear()
+        self._duplicate.clear()
+        self._reorder.clear()
+        self._replay.clear()
+        self._replay_buffers.clear()
 
     def set_loss(self, a: int, b: int, probability: float) -> None:
         """Drop a fraction of messages on the directed link a->b."""
         self._loss[(a, b)] = probability
+
+    def set_duplicate(self, a: int, b: int, probability: float) -> None:
+        """Deliver a fraction of messages on a->b TWICE (second copy lands
+        one extra delay later — a retransmitting/duplicating network)."""
+        self._duplicate[(a, b)] = probability
+
+    def set_reorder(self, a: int, b: int, probability: float) -> None:
+        """Hold back a fraction of messages on a->b so messages sent after
+        them arrive first (delivery delay inflated 2-5x, seeded RNG)."""
+        self._reorder[(a, b)] = probability
+
+    def set_replay(self, a: int, b: int, probability: float) -> None:
+        """Capture messages crossing a->b and, per send, with the given
+        probability ALSO re-deliver one stale captured message — the
+        signed-message replay adversary.  Captures are bounded
+        (:attr:`REPLAY_BUFFER_DEPTH`) and cleared by :meth:`heal`."""
+        self._replay[(a, b)] = probability
+        self._replay_buffers.setdefault((a, b), deque(maxlen=self.REPLAY_BUFFER_DEPTH))
 
     def reachable(self, a: int, b: int) -> bool:
         """Whether a message from ``a`` could currently reach ``b`` —
@@ -109,6 +188,14 @@ class SimNetwork:
 
     # --- transport ---------------------------------------------------------
 
+    def _record_injected(self, kind: str, sender: int, target: int) -> None:
+        self.injected[kind] += 1
+        if self.metrics is not None:
+            getattr(self.metrics, f"count_{kind}").add(1)
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            tracer.instant("net", f"net.{kind}", sender=sender, target=target)
+
     def send(self, sender: int, target: int, payload, *, is_request: bool) -> None:
         if sender not in self._handlers:
             return  # a crashed (unregistered) process cannot transmit:
@@ -120,13 +207,46 @@ class SimNetwork:
             return
         loss = self._loss.get((sender, target), 0.0)
         if loss and self.rng.random() < loss:
+            self._record_injected("dropped", sender, target)
             return
         if self.mutate_send is not None:
             payload = self.mutate_send(sender, target, payload)
             if payload is None:
+                self._record_injected("dropped", sender, target)
                 return
         delay = self._delay.get((sender, target), self.default_delay)
 
+        link = (sender, target)
+        replay_p = self._replay.get(link, 0.0)
+        if replay_p:
+            buf = self._replay_buffers[link]
+            if buf and self.rng.random() < replay_p:
+                stale_payload, stale_is_request = buf[0]  # the STALEST capture
+                self._record_injected("replayed", sender, target)
+                self._schedule_delivery(
+                    sender, target, stale_payload, stale_is_request,
+                    delay + self.default_delay,
+                )
+            buf.append((payload, is_request))
+
+        reorder_p = self._reorder.get(link, 0.0)
+        if reorder_p and self.rng.random() < reorder_p:
+            # Held back past 1-4 subsequently-sent messages' delivery times.
+            self._record_injected("reordered", sender, target)
+            delay = delay * (2 + 3 * self.rng.random())
+
+        self._schedule_delivery(sender, target, payload, is_request, delay)
+
+        dup_p = self._duplicate.get(link, 0.0)
+        if dup_p and self.rng.random() < dup_p:
+            self._record_injected("duplicated", sender, target)
+            self._schedule_delivery(
+                sender, target, payload, is_request, delay + self.default_delay
+            )
+
+    def _schedule_delivery(
+        self, sender: int, target: int, payload, is_request: bool, delay: float
+    ) -> None:
         def deliver() -> None:
             handler = self._handlers.get(target)
             if handler is None:
@@ -134,6 +254,7 @@ class SimNetwork:
             if self.lose_messages is not None and self.lose_messages(
                 target, sender, payload
             ):
+                self._record_injected("dropped", sender, target)
                 return
             handler(sender, payload, is_request)
 
@@ -157,4 +278,4 @@ class NodeComm(Comm):
         return self._network.node_ids()
 
 
-__all__ = ["SimNetwork", "NodeComm"]
+__all__ = ["SimNetwork", "NodeComm", "INJECTED_EVENT_KINDS"]

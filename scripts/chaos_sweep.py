@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Chaos seed sweep: run generated ChaosSchedules across a seed range and
+report per-seed verdicts plus one machine-readable JSON summary line.
+
+The pytest-gated smoke set (tests/test_chaos_engine.py, tests/test_soak.py)
+keeps tier-1 fast; THIS is the wide-net tool — point it at thousands of
+seeds overnight, and when a seed fails, ``--shrink-on-failure`` delta-
+debugs the schedule down to a minimal reproducer and prints a paste-able
+snippet, so the artifact of a sweep failure is a 2-3 action test case,
+not a seed number and an apology.
+
+Examples:
+
+    python scripts/chaos_sweep.py --start 0 --count 200
+    python scripts/chaos_sweep.py --start 0 --count 50 --window 0.05 -n 7
+    python scripts/chaos_sweep.py --start 4000 --count 1000 \\
+        --shrink-on-failure --json-out /tmp/sweep.json
+
+The final stdout line is always a single JSON object:
+
+    {"swept": N, "failed": K, "seeds_failed": [...], "params": {...}}
+
+Exit status: 0 when every seed passes, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # runnable from the repo root without installing
+
+from consensus_tpu.testing.chaos import (  # noqa: E402
+    ChaosEngine,
+    ChaosSchedule,
+    format_repro,
+    shrink,
+)
+
+
+def run_sweep(args) -> int:
+    failed: list[int] = []
+    for seed in range(args.start, args.start + args.count):
+        schedule = ChaosSchedule.generate(
+            seed, n=args.nodes, steps=args.steps,
+            durability_window=args.window,
+        )
+        result = ChaosEngine(schedule).run()
+        if result.ok:
+            if args.verbose:
+                height = max(len(d) for d in result.ledgers.values())
+                print(f"seed {seed}: ok (height {height}, "
+                      f"{result.deliveries} deliveries)")
+            continue
+        failed.append(seed)
+        v = result.violation
+        print(f"seed {seed}: FAIL {v.invariant} at sim t={v.sim_time:.4f}")
+        print(f"  {v.detail}")
+        if args.shrink_on_failure:
+            small, shrunk_result = shrink(
+                schedule, invariant=v.invariant, max_runs=args.shrink_budget
+            )
+            print(f"  shrunk {len(schedule.actions)} -> "
+                  f"{len(small.actions)} actions; reproduce with:")
+            for line in format_repro(shrunk_result).splitlines():
+                print(f"    {line}")
+        else:
+            print("  (re-run with --shrink-on-failure for a minimal repro)")
+
+    summary = {
+        "swept": args.count,
+        "failed": len(failed),
+        "seeds_failed": failed,
+        "params": {
+            "start": args.start,
+            "nodes": args.nodes,
+            "steps": args.steps,
+            "window": args.window,
+        },
+    }
+    line = json.dumps(summary, sort_keys=True)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if failed else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("--start", type=int, default=0, help="first seed")
+    ap.add_argument("--count", type=int, default=100, help="number of seeds")
+    ap.add_argument("-n", "--nodes", type=int, default=4, help="cluster size")
+    ap.add_argument("--steps", type=int, default=12,
+                    help="adversary actions per schedule")
+    ap.add_argument("--window", type=float, default=0.0,
+                    help="group-commit durability window (sim seconds)")
+    ap.add_argument("--shrink-on-failure", action="store_true",
+                    help="ddmin failing schedules to minimal reproducers")
+    ap.add_argument("--shrink-budget", type=int, default=200,
+                    help="max engine runs per shrink")
+    ap.add_argument("--json-out", help="also write the summary line here")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print passing seeds too")
+    return run_sweep(ap.parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

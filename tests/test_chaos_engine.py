@@ -1,0 +1,259 @@
+"""The chaos engine itself (consensus_tpu/testing/chaos.py + invariants.py):
+schedule generation, determinism, the invariant monitor's delivery-time
+detection, and the ddmin shrinker — validated end-to-end against a seeded
+SENTINEL bug (a deliberately mis-wired quorum check, test-only flag in
+core/view.py) that the whole apparatus must find, localize in sim-time,
+and shrink to a minimal reproducer.
+"""
+
+import threading
+
+import pytest
+
+import consensus_tpu.core.view as view_mod
+from consensus_tpu.testing.chaos import (
+    ChaosAction,
+    ChaosEngine,
+    ChaosSchedule,
+    format_repro,
+    shrink,
+)
+from consensus_tpu.testing.faults import FaultPlan, SimulatedCrash
+from consensus_tpu.testing.invariants import InvariantViolation
+
+# --- schedule generation ----------------------------------------------------
+
+
+def test_generate_is_deterministic_and_seed_sensitive():
+    a = ChaosSchedule.generate(42, steps=15)
+    b = ChaosSchedule.generate(42, steps=15)
+    c = ChaosSchedule.generate(43, steps=15)
+    assert a == b
+    assert a != c
+    assert len(a.actions) == 15
+    ats = [act.at for act in a.actions]
+    assert ats == sorted(ats), "actions must be sim-clock ordered"
+
+
+@pytest.mark.parametrize("seed", [1, 7, 19, 20260728])
+def test_generate_stays_inside_the_fault_model(seed):
+    # ≤ f replicas down-or-doomed and ≤ max(f, 1) byzantine senders at any
+    # point of the schedule — otherwise a violation would indict the
+    # adversary, not the protocol.
+    for n in (4, 7):
+        sched = ChaosSchedule.generate(seed, n=n, steps=30)
+        f = (n - 1) // 3
+        down, byz = set(), set()
+        for act in sched.actions:
+            if act.kind in ("crash", "arm_fault"):
+                down.add(act.args["node"])
+            elif act.kind == "restart":
+                down.discard(act.args["node"])
+            elif act.kind == "byzantine":
+                byz.add(act.args["node"])
+            elif act.kind == "byzantine_stop":
+                byz.clear()
+            assert len(down) <= f, f"{n=} schedule exceeds f crashed"
+            assert len(byz) <= max(f, 1), f"{n=} schedule exceeds f byzantine"
+
+
+# --- the seeded sentinel bug ------------------------------------------------
+
+#: A schedule whose crash of the view-0 leader forces a view change, after
+#: which the sentinel's undersized quorum check is live; the trailing
+#: actions are deliberate noise for the shrinker to strip.
+SENTINEL_SCHEDULE = ChaosSchedule(
+    seed=7,
+    n=4,
+    durability_window=0.0,
+    actions=(
+        ChaosAction(at=35.0, kind="loss", args={"a": 2, "b": 3, "p": 0.3}),
+        ChaosAction(at=50.0, kind="delay", args={"a": 1, "b": 4, "d": 0.2}),
+        ChaosAction(at=65.0, kind="crash", args={"node": 1}),
+        ChaosAction(at=80.0, kind="duplicate", args={"a": 2, "b": 4, "p": 0.3}),
+        ChaosAction(at=95.0, kind="heal"),
+        ChaosAction(at=110.0, kind="restart", args={"node": 1}),
+        ChaosAction(at=130.0, kind="reorder", args={"a": 3, "b": 2, "p": 0.3}),
+        ChaosAction(at=150.0, kind="heal"),
+    ),
+)
+
+
+@pytest.fixture
+def sentinel_bug():
+    view_mod.SENTINEL_MISWIRED_QUORUM = True
+    try:
+        yield
+    finally:
+        view_mod.SENTINEL_MISWIRED_QUORUM = False
+
+
+def test_monitor_detects_sentinel_at_delivery_time(sentinel_bug):
+    result = ChaosEngine(SENTINEL_SCHEDULE).run()
+    assert not result.ok
+    v = result.violation
+    assert v.invariant == "quorum-cert"
+    # AT DELIVERY TIME: the violation is pinned inside the schedule window
+    # (the undersized decision lands right after the post-crash view
+    # change), not discovered by an end-of-run audit after the liveness
+    # probe (which would put it past the final action + settle time).
+    assert v.sim_time < SENTINEL_SCHEDULE.actions[-1].at
+    assert v.node is not None
+    assert "quorum is 3" in v.detail
+    # The action history travels with the violation.
+    assert any("crash" in line for line in v.history)
+    # The engine stopped the schedule early instead of burying the signal.
+    assert b"VIOLATION quorum-cert" in result.event_log
+
+
+def test_sentinel_is_dormant_without_a_view_change(sentinel_bug):
+    # In view 0 the mis-wiring is behind `self.number > 0`: a quiet run
+    # must stay clean, which is what makes the crash action load-bearing
+    # for the reproducer (and the shrinker's convergence meaningful).
+    quiet = ChaosSchedule(seed=7, n=4, actions=())
+    result = ChaosEngine(quiet).run()
+    assert result.ok, result.violation
+
+
+def test_shrinker_converges_to_minimal_reproducer(sentinel_bug):
+    small, res = shrink(SENTINEL_SCHEDULE, invariant="quorum-cert")
+    assert len(small.actions) <= 3, (
+        f"shrinker left {len(small.actions)} actions: {small.actions}"
+    )
+    # The crash (the only action that can force the view change) survived.
+    assert any(a.kind == "crash" for a in small.actions)
+    assert res.violation.invariant == "quorum-cert"
+
+    # The repro snippet is executable Python that reproduces the failure.
+    snippet = format_repro(res)
+    scope = {}
+    exec(compile(snippet, "<repro>", "exec"), scope)
+    assert scope["result"].violation.invariant == "quorum-cert"
+    assert scope["result"].event_log == res.event_log
+
+
+def test_shrink_refuses_a_passing_schedule():
+    with pytest.raises(ValueError, match="does not fail"):
+        shrink(ChaosSchedule(seed=7, n=4, actions=()))
+
+
+# --- engine smoke + sweep ---------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [2, 5, 9])
+def test_engine_smoke(seed):
+    sched = ChaosSchedule.generate(seed, steps=10)
+    result = ChaosEngine(sched).run()
+    assert result.ok, (
+        f"{result.violation}\n\nreproduce with:\n{format_repro(result)}"
+    )
+    assert result.deliveries > 0
+
+
+def test_engine_smoke_group_commit():
+    sched = ChaosSchedule.generate(3, steps=10, durability_window=0.05)
+    result = ChaosEngine(sched).run()
+    assert result.ok, (
+        f"{result.violation}\n\nreproduce with:\n{format_repro(result)}"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(500, 540)))
+def test_engine_wide_sweep(seed):
+    sched = ChaosSchedule.generate(seed, steps=12)
+    result = ChaosEngine(sched).run()
+    assert result.ok, (
+        f"{result.violation}\n\nreproduce with:\n{format_repro(result)}"
+    )
+
+
+def test_assert_clean_raises_with_context():
+    sched = ChaosSchedule.generate(2, steps=5)
+    engine = ChaosEngine(sched)
+    result = engine.run()
+    assert result.ok
+    engine.monitor.record("liveness", None, "synthetic for the error path")
+    with pytest.raises(InvariantViolation, match="synthetic"):
+        engine.monitor.assert_clean()
+    v = engine.monitor.first
+    assert v.history, "violations must carry the action history"
+
+
+# --- scripts/chaos_sweep.py -------------------------------------------------
+
+
+def _run_sweep_script(*argv):
+    import json
+    import os
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "scripts/chaos_sweep.py", *argv],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300,
+    )
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    return proc, summary
+
+
+def test_chaos_sweep_script_smoke():
+    proc, summary = _run_sweep_script("--start", "0", "--count", "3",
+                                      "--steps", "8")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert summary["swept"] == 3
+    assert summary["failed"] == 0
+    assert summary["seeds_failed"] == []
+    assert summary["params"]["steps"] == 8
+
+
+@pytest.mark.slow
+def test_chaos_sweep_script_wide():
+    proc, summary = _run_sweep_script("--start", "1000", "--count", "60")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert summary["failed"] == 0
+
+
+# --- FaultPlan crash-seam race (the _count_hit lock fix) --------------------
+
+
+def test_fault_plan_crash_race_two_threads():
+    """Transport/sidecar seams race the consensus thread into the same
+    plan.  The dead-check, hit count, and dead-set are one critical
+    section (_count_hit): exactly ONE thread may observe the armed firing,
+    and no zombie touch lands a countable hit after death.  Before the
+    fix, self.dead was read and set outside the lock — two threads could
+    both fire (double on_crash teardown), which this loop makes likely
+    enough to catch."""
+    point = "net.send.io_error"
+    for _ in range(200):
+        plan = FaultPlan(point, on_hit=3)
+        teardowns = []
+        plan.on_crash = lambda: teardowns.append(1)
+        fired = []
+        barrier = threading.Barrier(2)
+
+        def worker():
+            barrier.wait()
+            for _ in range(5):
+                try:
+                    plan.crash(point)
+                except SimulatedCrash as e:
+                    if "injected crash" in str(e):
+                        fired.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(fired) == 1, f"{len(fired)} threads observed the firing"
+        assert len(teardowns) == 1, "on_crash ran more than once"
+        assert plan.fired == (point, 3)
+        assert plan.dead
+        # Countable hits stop at death: 2 survivable + the fatal third.
+        assert plan.hits[point] == 3

@@ -1,0 +1,183 @@
+"""SimNetwork adversary primitives: the byzantine-network knobs the chaos
+engine drives (duplicate / reorder / stale replay), injected-event
+accounting, hook composition on one link, and the heal()/partition()
+edge cases the soak loops leaned on implicitly.
+"""
+
+import pytest
+
+from consensus_tpu.runtime.scheduler import SimScheduler
+from consensus_tpu.testing.network import INJECTED_EVENT_KINDS, SimNetwork
+
+
+def _net(seed=0, ids=(1, 2, 3, 4)):
+    sched = SimScheduler()
+    net = SimNetwork(sched, seed=seed)
+    inboxes = {}
+    for nid in ids:
+        inboxes[nid] = []
+        net.register(
+            nid,
+            (lambda box: lambda s, p, r: box.append((sched.now(), s, p)))(
+                inboxes[nid]
+            ),
+        )
+    return sched, net, inboxes
+
+
+# --- heal() must clear EVERY knob ------------------------------------------
+
+
+def test_heal_clears_per_link_delay_overrides():
+    """Regression: heal() cleared cuts/disconnects/loss but LEFT per-link
+    delay overrides armed, so a 'healed' network kept a slow link forever
+    — post-heal liveness assertions were running against residual
+    adversary state."""
+    sched, net, inboxes = _net()
+    net.set_delay(1, 2, 5.0)
+    net.heal()
+    net.send(1, 2, b"x", is_request=True)
+    sched.advance(0.01)
+    assert inboxes[2], "message lost after heal"
+    at, _, _ = inboxes[2][0]
+    assert at == pytest.approx(net.default_delay), (
+        f"delivered at {at}: the pre-heal delay override survived heal()"
+    )
+
+
+def test_heal_clears_byzantine_network_knobs_and_buffers():
+    sched, net, inboxes = _net()
+    net.set_duplicate(1, 2, 1.0)
+    net.set_reorder(1, 2, 1.0)
+    net.set_replay(1, 2, 1.0)
+    net.send(1, 2, b"seed-capture", is_request=True)
+    sched.advance(0.1)
+    net.heal()
+    before = dict(net.injected)
+    inboxes[2].clear()
+    net.send(1, 2, b"clean", is_request=True)
+    sched.advance(0.1)
+    assert [p for _, _, p in inboxes[2]] == [b"clean"]  # exactly once
+    assert dict(net.injected) == before, "healed network still injecting"
+
+
+# --- the byzantine-network primitives --------------------------------------
+
+
+def test_duplicate_delivers_twice_and_counts():
+    sched, net, inboxes = _net()
+    net.set_duplicate(1, 2, 1.0)
+    net.send(1, 2, b"m", is_request=True)
+    sched.advance(0.1)
+    assert [p for _, _, p in inboxes[2]] == [b"m", b"m"]
+    assert net.injected["duplicated"] == 1
+
+
+def test_reorder_lets_later_sends_overtake():
+    sched, net, inboxes = _net()
+    net.set_reorder(1, 2, 1.0)
+    net.send(1, 2, b"first", is_request=True)  # held back 2-5x delay
+    net.set_reorder(1, 2, 0.0)
+    net.send(1, 2, b"second", is_request=True)
+    sched.advance(0.1)
+    assert [p for _, _, p in inboxes[2]] == [b"second", b"first"]
+    assert net.injected["reordered"] == 1
+
+
+def test_replay_redelivers_the_stalest_capture():
+    sched, net, inboxes = _net()
+    net.set_replay(1, 2, 1.0)
+    net.send(1, 2, b"old", is_request=True)   # buffer empty: captured only
+    net.send(1, 2, b"new", is_request=True)   # replays the stale b"old"
+    sched.advance(0.1)
+    payloads = sorted(p for _, _, p in inboxes[2])
+    assert payloads == [b"new", b"old", b"old"]
+    assert net.injected["replayed"] == 1
+
+
+def test_unarmed_knobs_consume_no_rng():
+    """Pinned soak/chaos seeds replay the exact rng stream the network
+    consumed when they were recorded — the duplicate/reorder/replay knobs
+    must draw NOTHING while unarmed, or every pre-existing seed shifts."""
+    sched, net, _ = _net(seed=99)
+    state = net.rng.getstate()
+    for i in range(50):
+        net.send(1, 2, b"m%d" % i, is_request=False)
+    assert net.rng.getstate() == state
+
+
+def test_injected_counter_covers_exactly_the_contract_kinds():
+    sched, net, _ = _net()
+    net.set_loss(1, 2, 1.0)
+    net.send(1, 2, b"m", is_request=True)
+    sched.advance(0.01)
+    assert net.injected["dropped"] == 1
+    assert set(net.injected) <= set(INJECTED_EVENT_KINDS)
+
+
+# --- hook composition on one link ------------------------------------------
+
+
+def test_mutate_lose_and_loss_compose_on_one_link():
+    """All three per-message hooks armed on the SAME link: loss rolls
+    first, mutate_send next (None vetoes), the receiver-side filter last —
+    and every non-delivered message is accounted as an injected drop, so
+    sent == delivered + injected regardless of which stage ate it."""
+    sched, net, inboxes = _net(seed=5)
+    net.set_loss(1, 2, 0.5)
+    net.mutate_send = lambda s, t, m: None if m.startswith(b"veto") else m + b"|mut"
+    net.lose_messages = lambda t, s, m: m.startswith(b"filter")
+    sent = [b"m%d" % i for i in range(20)]
+    sent += [b"veto-a", b"veto-b", b"filter-a", b"filter-b"]
+    for m in sent:
+        net.send(1, 2, m, is_request=True)
+    sched.advance(0.1)
+    delivered = [p for _, _, p in inboxes[2]]
+    assert delivered, "loss p=0.5 cannot have eaten everything (seeded)"
+    assert all(p.endswith(b"|mut") for p in delivered)
+    assert not any(p.startswith(b"filter") for p in delivered)
+    assert len(delivered) + net.injected["dropped"] == len(sent)
+
+    # And the composition is deterministic: same seed, same survivors.
+    sched2, net2, inboxes2 = _net(seed=5)
+    net2.set_loss(1, 2, 0.5)
+    net2.mutate_send = lambda s, t, m: None if m.startswith(b"veto") else m + b"|mut"
+    net2.lose_messages = lambda t, s, m: m.startswith(b"filter")
+    for m in sent:
+        net2.send(1, 2, m, is_request=True)
+    sched2.advance(0.1)
+    assert [p for _, _, p in inboxes2[2]] == delivered
+
+
+# --- partition vs crashed nodes --------------------------------------------
+
+
+def test_partition_leaks_around_crashed_node_without_membership():
+    """Documents the footgun the partition() docstring warns about: with
+    no ``membership`` set, the boundary is computed over the LIVE
+    registration set, so a node crashed (unregistered) at partition time
+    gets no cut links — after it restarts, traffic to and from it tunnels
+    straight through the 'partition'.  Cluster avoids this by setting
+    membership to the full configured id set."""
+    sched, net, inboxes = _net()
+    net.unregister(3)  # crashed
+    net.partition([1])  # cuts computed over live ids {1, 2, 4} only
+    # The cut works against live nodes...
+    net.send(1, 2, b"cut?", is_request=True)
+    sched.advance(0.01)
+    assert not inboxes[2]
+    # ...but the restarted node was never cut: the partition leaks.
+    net.register(3, lambda s, p, r: inboxes[3].append((sched.now(), s, p)))
+    net.send(1, 3, b"leak", is_request=True)
+    sched.advance(0.01)
+    assert [p for _, _, p in inboxes[3]] == [b"leak"]
+
+    # With membership set (what Cluster does), the same sequence is tight.
+    sched, net, inboxes = _net()
+    net.membership = [1, 2, 3, 4]
+    net.unregister(3)
+    net.partition([1])
+    net.register(3, lambda s, p, r: inboxes[3].append((sched.now(), s, p)))
+    net.send(1, 3, b"leak?", is_request=True)
+    sched.advance(0.01)
+    assert not inboxes[3]

@@ -255,3 +255,55 @@ def test_dump_keys_pinned_and_trace_feeds_same_values():
     assert report["fsync_records"][-1] == (
         dump[WAL_RECORDS_PER_FSYNC_KEY]["value"]
     )
+
+
+def test_net_injected_event_keys_pinned_and_mirror_trace_instants():
+    """The chaos engine's injected network events (testing/network.py) are
+    triple-booked: the SimNetwork.injected counter, the pinned-key metrics
+    counters, and per-event ``net.<kind>`` tracer instants.  All three
+    must agree event-for-event, and the key names are a contract."""
+    from collections import Counter
+
+    from consensus_tpu.metrics import (
+        NET_DROPPED_KEY,
+        NET_DUPLICATED_KEY,
+        NET_INJECTED_KEYS,
+        NET_REORDERED_KEY,
+        NET_REPLAYED_KEY,
+    )
+    from consensus_tpu.runtime.scheduler import SimScheduler
+    from consensus_tpu.testing.network import INJECTED_EVENT_KINDS, SimNetwork
+
+    assert NET_DROPPED_KEY == "net_injected_dropped"
+    assert NET_DUPLICATED_KEY == "net_injected_duplicated"
+    assert NET_REORDERED_KEY == "net_injected_reordered"
+    assert NET_REPLAYED_KEY == "net_injected_replayed"
+    assert NET_INJECTED_KEYS == tuple(
+        f"net_injected_{kind}" for kind in INJECTED_EVENT_KINDS
+    )
+
+    provider = InMemoryProvider()
+    sched = SimScheduler()
+    net = SimNetwork(sched, seed=3)
+    net.metrics = Metrics(provider).network
+    tracer = Tracer(sched.now, capacity=8192)
+    net.tracer = tracer
+    net.register(1, lambda s, p, r: None)
+    net.register(2, lambda s, p, r: None)
+    net.set_loss(1, 2, 0.3)
+    net.set_duplicate(1, 2, 0.3)
+    net.set_reorder(1, 2, 0.3)
+    net.set_replay(1, 2, 0.3)
+    for i in range(300):
+        net.send(1, 2, b"m%d" % i, is_request=True)
+        sched.advance(0.002)
+    sched.advance(1.0)
+
+    assert sum(net.injected.values()) > 0, "seeded run must inject"
+    dump = provider.dump()
+    instants = Counter(
+        ev[2] for ev in tracer.events() if ev[0] == "i" and ev[1] == "net"
+    )
+    for kind in INJECTED_EVENT_KINDS:
+        assert dump[f"net_injected_{kind}"]["value"] == net.injected[kind]
+        assert instants[f"net.{kind}"] == net.injected[kind]
