@@ -143,6 +143,19 @@ class Verifier(abc.ABC):
 
     # --- batch entry points (TPU acceleration seam) ---------------------
 
+    #: True when this verifier is backed by a randomized batch-verification
+    #: engine (Configuration.batch_verify_mode) — one aggregate check per
+    #: batch amortizes the doubling chain, so the multi-batch default below
+    #: coalesces every group into a single launch instead of looping.
+    batch_verify_enabled: bool = False
+
+    #: Facades that delegate signature checks to an inner crypto verifier
+    #: (e.g. testing.crypto_app.CryptoApp) set this to that inner verifier
+    #: so the coalesced multi-batch path reaches the engine in ONE call —
+    #: without it the default loop would split a sync chunk's quorum certs
+    #: into per-group launches and re-pay the doubling chain per group.
+    multi_batch_delegate: Optional["Verifier"] = None
+
     def verify_requests_batch(self, raw_requests: Sequence[bytes]) -> list[Optional[RequestInfo]]:
         """Verify many requests; element is None where verification failed.
 
@@ -181,8 +194,15 @@ class Verifier(abc.ABC):
 
         Default loops over ``verify_consenter_sigs_batch``; TPU verifiers
         override to flatten every (proposal, signature) pair into one
-        device batch.
+        device batch.  When the randomized batch verifier is enabled
+        (``batch_verify_enabled``) and a ``multi_batch_delegate`` is wired,
+        the default instead forwards the whole group list to the delegate's
+        coalescing implementation — one launch for all groups, with the
+        engine's bisection localizing any failing group on its own.
         """
+        delegate = self.multi_batch_delegate
+        if self.batch_verify_enabled and delegate is not None:
+            return delegate.verify_consenter_sigs_multi_batch(groups)
         return [
             self.verify_consenter_sigs_batch(sigs, proposal)
             for proposal, sigs in groups

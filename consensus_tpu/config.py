@@ -91,6 +91,13 @@ class Configuration:
     # Pad verification batches up to the next power of two (stable XLA shapes,
     # avoids recompilation across batch sizes).
     crypto_pad_pow2: bool = True
+    # Randomized Ed25519 batch verification (one shared-doubling aggregate
+    # check per batch, bisection fallback on failure — models/ed25519.py
+    # Ed25519RandomizedBatchVerifier).  Default off: all replicas in a
+    # cluster must agree on this flag, since batch verdicts on adversarial
+    # torsion-component signatures can differ from the strict kernel's
+    # (SAFETY.md §7).
+    batch_verify_mode: bool = False
 
     # --- decision-lifecycle tracing (no reference counterpart) ----------
     trace: TraceConfig = field(default=TraceConfig())

@@ -1,7 +1,11 @@
 """Batched signature-verification models built on :mod:`consensus_tpu.ops`."""
 
 from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
-from consensus_tpu.models.ed25519 import Ed25519BatchVerifier, L
+from consensus_tpu.models.ed25519 import (
+    Ed25519BatchVerifier,
+    Ed25519RandomizedBatchVerifier,
+    L,
+)
 from consensus_tpu.models.engine import BatchCoalescer, ThreadCoalescingVerifier
 from consensus_tpu.models.verifier import (
     EcdsaP256Signer,
@@ -9,6 +13,7 @@ from consensus_tpu.models.verifier import (
     Ed25519Signer,
     Ed25519VerifierMixin,
     commit_message,
+    engine_for_config,
     raw_message,
 )
 
@@ -17,11 +22,13 @@ __all__ = [
     "EcdsaP256Signer",
     "EcdsaP256VerifierMixin",
     "Ed25519BatchVerifier",
+    "Ed25519RandomizedBatchVerifier",
     "L",
     "BatchCoalescer",
     "ThreadCoalescingVerifier",
     "Ed25519Signer",
     "Ed25519VerifierMixin",
     "commit_message",
+    "engine_for_config",
     "raw_message",
 ]

@@ -27,7 +27,10 @@ import struct
 from typing import Mapping, Optional, Sequence
 
 from consensus_tpu.api.deps import Signer, Verifier
-from consensus_tpu.models.ed25519 import Ed25519BatchVerifier
+from consensus_tpu.models.ed25519 import (
+    Ed25519BatchVerifier,
+    Ed25519RandomizedBatchVerifier,
+)
 from consensus_tpu.types import Proposal, RequestInfo, Signature
 
 _COMMIT_TAG = b"ctpu/commit"
@@ -41,6 +44,22 @@ def commit_message(proposal: Proposal, aux: bytes) -> bytes:
 
 def raw_message(data: bytes) -> bytes:
     return _RAW_TAG + data
+
+
+def engine_for_config(config) -> Ed25519BatchVerifier:
+    """The Ed25519 batch engine matching a ``Configuration``'s crypto knobs
+    (``batch_verify_mode``, ``crypto_pad_pow2``, ``crypto_tpu_min_batch``).
+    Every replica in a cluster must build its engine from the same config —
+    verdict parity across replicas is a quorum-safety requirement."""
+    cls = (
+        Ed25519RandomizedBatchVerifier
+        if getattr(config, "batch_verify_mode", False)
+        else Ed25519BatchVerifier
+    )
+    return cls(
+        pad_pow2=config.crypto_pad_pow2,
+        min_device_batch=config.crypto_tpu_min_batch,
+    )
 
 
 class Ed25519Signer(Signer):
@@ -105,9 +124,28 @@ class Ed25519VerifierMixin(Verifier):
         public_keys: Mapping[int, bytes],
         *,
         engine: Optional[Ed25519BatchVerifier] = None,
+        batch_verify_mode: bool = False,
     ) -> None:
+        """``batch_verify_mode`` (Configuration.batch_verify_mode) selects
+        the randomized aggregate-check engine as the default; an explicit
+        ``engine`` wins, but passing a non-randomized engine together with
+        the flag is a config contradiction and raises."""
         self._public_keys = dict(public_keys)
-        self._engine = engine or Ed25519BatchVerifier()
+        if engine is None:
+            engine = (
+                Ed25519RandomizedBatchVerifier()
+                if batch_verify_mode
+                else Ed25519BatchVerifier()
+            )
+        elif batch_verify_mode and not getattr(engine, "randomized", False):
+            raise ValueError(
+                "batch_verify_mode=True requires a randomized engine "
+                "(got %r)" % type(engine).__name__
+            )
+        self._engine = engine
+        #: Consumed by api.deps facades (CryptoApp etc.) to decide whether
+        #: the default multi-batch loop may coalesce through this verifier.
+        self.batch_verify_enabled = bool(getattr(engine, "randomized", False))
 
     def set_public_keys(self, public_keys: Mapping[int, bytes]) -> None:
         """Swap the key registry (reconfiguration)."""
@@ -234,5 +272,6 @@ __all__ = [
     "EcdsaP256Signer",
     "EcdsaP256VerifierMixin",
     "commit_message",
+    "engine_for_config",
     "raw_message",
 ]

@@ -42,7 +42,18 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from consensus_tpu.ops import limbs
 from consensus_tpu.ops.limbs import carry_i32
+
+
+def _note_lanes(a, b=None) -> int:
+    """Independent field elements an op touches: product of the broadcast
+    batch dims (everything after the leading limb axis)."""
+    shape = a.shape if b is None else jnp.broadcast_shapes(a.shape, b.shape)
+    lanes = 1
+    for dim in shape[1:]:
+        lanes *= int(dim)
+    return lanes
 
 LIMBS = 32
 LIMB_BITS = 8
@@ -194,6 +205,8 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     Exact while |a_limb| * |b_limb| <= 2^19 (columns sum 32 products under
     the f32 24-bit integer window) — weakly reduced inputs and one raw
     add/sub level both qualify."""
+    if limbs.counting():
+        limbs.note_mul(_note_lanes(a, b))
     batch_pad = [(0, 0)] * (a.ndim - 1)
     terms = [
         jnp.pad(a[i] * b, [(i, LIMBS - 1 - i)] + batch_pad) for i in range(LIMBS)
@@ -208,6 +221,8 @@ def square(a: jnp.ndarray) -> jnp.ndarray:
 
     Exactness requires |limb| <= 500 (2 * 500^2 * 32 < 2^24); callers with
     one-raw-level inputs (bound 680) must use ``mul(x, x)`` instead."""
+    if limbs.counting():
+        limbs.note_square(_note_lanes(a))
     batch_pad = [(0, 0)] * (a.ndim - 1)
     doubled = a + a
     terms = []
@@ -275,8 +290,6 @@ def pow_const(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
     """x ** exponent for a fixed public exponent, via an MSB-first
     square-and-multiply ``lax.scan`` (compiles to a rolled loop — the graph
     stays small regardless of exponent length)."""
-    import jax
-
     bits = [(exponent >> i) & 1 for i in range(exponent.bit_length())][::-1]
     bits_arr = jnp.asarray(np.array(bits, dtype=np.int32))
 
@@ -286,7 +299,7 @@ def pow_const(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
         return acc, None
 
     # First bit is always 1: start from x to save one square+mul.
-    acc, _ = jax.lax.scan(step, x, bits_arr[1:])
+    acc, _ = limbs.counted_scan(step, x, bits_arr[1:])
     return acc
 
 
@@ -297,11 +310,9 @@ def invert(x: jnp.ndarray) -> jnp.ndarray:
 
 def _square_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
     """n successive squarings as a rolled scan (one body in the graph)."""
-    import jax
-
     if n == 1:
         return square(x)
-    acc, _ = jax.lax.scan(lambda a, _: (square(a), None), x, None, length=n)
+    acc, _ = limbs.counted_scan(lambda a, _: (square(a), None), x, None, length=n)
     return acc
 
 

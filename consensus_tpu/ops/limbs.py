@@ -4,9 +4,23 @@ Both GF(2^255-19) (:mod:`consensus_tpu.ops.field25519`) and the P-256 field
 (:mod:`consensus_tpu.ops.field_p256`) represent elements as 32x8-bit limb
 vectors; the exact sequential int32 carry normalization is identical and
 lives here so a carry-semantics fix can never diverge between curves.
+
+This module also hosts the **field-multiplication counting shim** that makes
+kernel cost models *measured* instead of estimated (BASELINE.md).  The field
+stacks report every ``mul``/``square`` through :func:`note_mul` /
+:func:`note_square`, weighted by how many independent field elements the op
+touches (the batch lanes) and by the length of every enclosing ``lax.scan``
+(:func:`counted_scan` — JAX traces a scan body once regardless of trip
+count, so the weight stack is what turns a trace into an operation count).
+:func:`measure_field_ops` runs a kernel under ``jax.eval_shape`` — abstract
+tracing only, no compilation, no device — so a batch-512 A/B costs seconds
+on CPU.  When no counter is active every hook is a cheap no-op and
+``counted_scan`` degrades to ``jax.lax.scan`` exactly.
 """
 
 from __future__ import annotations
+
+import contextlib
 
 import jax
 import jax.numpy as jnp
@@ -31,4 +45,120 @@ def carry_i32(x: jnp.ndarray, limb_bits: int = 8) -> tuple[jnp.ndarray, jnp.ndar
     return out, carry
 
 
-__all__ = ["carry_i32"]
+# --------------------------------------------------------------------------
+# Field-operation counting shim
+# --------------------------------------------------------------------------
+
+#: Active counters (a stack so measurements may nest) and the stack of
+#: enclosing-scan trip counts.  Trace-time state only — nothing here is ever
+#: captured into a compiled graph.
+_COUNTERS: list["FieldOpCount"] = []
+_SCAN_WEIGHTS: list[int] = []
+
+#: One squaring costs roughly this many generic multiplications in the
+#: schoolbook limb stack (the symmetric half of the product terms).
+SQUARE_M_RATIO = 0.55
+
+
+class FieldOpCount:
+    """Tally of field multiplications observed during one traced region."""
+
+    def __init__(self) -> None:
+        self.muls = 0
+        self.squares = 0
+
+    @property
+    def m_equiv(self) -> float:
+        """Generic-multiplication equivalents (1 S ~ 0.55 M)."""
+        return self.muls + SQUARE_M_RATIO * self.squares
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"FieldOpCount(muls={self.muls}, squares={self.squares})"
+
+
+def counting() -> bool:
+    """True while at least one :func:`count_field_ops` region is active."""
+    return bool(_COUNTERS)
+
+
+def _note(attr: str, lanes: int) -> None:
+    weight = lanes
+    for trip in _SCAN_WEIGHTS:
+        weight *= trip
+    for counter in _COUNTERS:
+        setattr(counter, attr, getattr(counter, attr) + weight)
+
+
+def note_mul(lanes: int = 1) -> None:
+    """Record a field multiplication over ``lanes`` independent elements."""
+    if _COUNTERS:
+        _note("muls", lanes)
+
+
+def note_square(lanes: int = 1) -> None:
+    """Record a field squaring over ``lanes`` independent elements."""
+    if _COUNTERS:
+        _note("squares", lanes)
+
+
+@contextlib.contextmanager
+def count_field_ops():
+    """Collect field-op notes emitted while tracing inside this block."""
+    counter = FieldOpCount()
+    _COUNTERS.append(counter)
+    try:
+        yield counter
+    finally:
+        _COUNTERS.remove(counter)
+
+
+def counted_scan(f, init, xs=None, length=None, **kwargs):
+    """``jax.lax.scan`` that weights the body's field-op notes by trip count.
+
+    JAX traces a scan body exactly once, so a naive trace-time tally would
+    count a 64-iteration Horner loop as one step.  While a counter is
+    active the body runs under a weight equal to the scan length; otherwise
+    this is ``jax.lax.scan`` verbatim.
+    """
+    if not _COUNTERS:
+        return jax.lax.scan(f, init, xs, length=length, **kwargs)
+    if length is not None:
+        trips = int(length)
+    else:
+        leaves = jax.tree_util.tree_leaves(xs)
+        trips = int(leaves[0].shape[0])
+
+    def weighted(carry, x):
+        _SCAN_WEIGHTS.append(trips)
+        try:
+            return f(carry, x)
+        finally:
+            _SCAN_WEIGHTS.pop()
+
+    return jax.lax.scan(weighted, init, xs, length=length, **kwargs)
+
+
+def measure_field_ops(fn, *args, **kwargs) -> FieldOpCount:
+    """Exact field-op count for one abstract trace of ``fn(*args)``.
+
+    Uses ``jax.eval_shape`` — no compilation, no execution, no device — so
+    counting a batch-512 verify kernel takes seconds on any host.  ``fn``
+    must be the *unjitted* implementation (a cached jit would skip tracing
+    and report zero).
+    """
+    with count_field_ops() as counter:
+        jax.eval_shape(fn, *args, **kwargs)
+    return counter
+
+
+__all__ = [
+    "carry_i32",
+    "FieldOpCount",
+    "SQUARE_M_RATIO",
+    "count_field_ops",
+    "counted_scan",
+    "counting",
+    "measure_field_ops",
+    "note_mul",
+    "note_square",
+]

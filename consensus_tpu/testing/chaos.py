@@ -225,12 +225,24 @@ class ChaosEngine:
         check_durability: bool = True,
         metrics=None,
         tracer=None,
+        crypto: Optional[str] = None,
     ) -> None:
+        """``crypto`` arms REAL Ed25519 on every replica signature path:
+        ``"ed25519"`` uses the strict batch engine, ``"ed25519-batch"`` the
+        randomized aggregate-check engine (Configuration.batch_verify_mode)
+        — node keys are derived from the schedule seed, so two engines run
+        on byte-identical schedules and must produce identical ledgers.
+        Crypto mode also unlocks a signature-corruption byzantine arm,
+        rolled on a dedicated RNG stream so non-crypto schedules replay
+        byte-for-byte unchanged."""
+        if crypto not in (None, "ed25519", "ed25519-batch"):
+            raise ValueError(f"unknown chaos crypto mode {crypto!r}")
         self.schedule = schedule
         self.config_tweaks = dict(config_tweaks or DEFAULT_TWEAKS)
         self.check_durability = check_durability
         self.metrics = metrics
         self.tracer = tracer
+        self.crypto = crypto
         self.cluster: Optional[Cluster] = None
         self.monitor: Optional[InvariantMonitor] = None
         self._log: list[str] = []
@@ -239,6 +251,10 @@ class ChaosEngine:
         #: Engine-owned mutation stream, independent of the network's RNG
         #: so arming byzantine mid-run cannot shift loss/duplicate rolls.
         self._byz_rng = random.Random(schedule.seed ^ 0xB12A)
+        #: Separate stream for the crypto-only signature-flip arm: never
+        #: consulted without ``crypto``, so existing pinned schedules keep
+        #: their exact mutation sequence.
+        self._sig_rng = random.Random(schedule.seed ^ 0x516)
 
     # --- bookkeeping --------------------------------------------------------
 
@@ -327,7 +343,24 @@ class ChaosEngine:
         corrupted at its configured rate.  Validation must shed all of it;
         ≤ f armed senders keeps this inside the threat model."""
         rate = self._byz_rules.get(sender)
-        if not rate or self._byz_rng.random() >= rate:
+        if not rate:
+            return msg
+        if self.crypto is not None:
+            # Crypto-only arm: flip a signature byte — real verification
+            # (strict or randomized-batch) must shed it.  Dedicated RNG so
+            # the shared _byz_rng stream (and every pinned non-crypto
+            # schedule) is untouched.
+            sig = getattr(msg, "signature", None)
+            value = getattr(sig, "value", None)
+            if value and self._sig_rng.random() < rate * 0.5:
+                flipped = bytearray(value)
+                i = self._sig_rng.randrange(len(flipped))
+                flipped[i] ^= 0xFF
+                return dataclasses.replace(
+                    msg,
+                    signature=dataclasses.replace(sig, value=bytes(flipped)),
+                )
+        if self._byz_rng.random() >= rate:
             return msg
         roll = self._byz_rng.random()
         digest = getattr(msg, "digest", None)
@@ -355,6 +388,48 @@ class ChaosEngine:
                 sync.fault_plan = None
                 sync.transport.fault_plan = None
 
+    def _install_crypto(self) -> None:
+        """Swap every node's app for a CryptoApp with REAL Ed25519 keys.
+
+        Keys are sha512-derived from the schedule seed (no ambient RNG), so
+        a strict-engine run and a randomized-batch run of the SAME schedule
+        sign and verify the exact same bytes — ledger divergence between
+        them can only come from the verifier, which is what the parity
+        gate is hunting.  Node.app survives crash()/restart(), so one
+        install covers the whole schedule."""
+        import hashlib
+
+        from consensus_tpu.models import Ed25519Signer
+        from consensus_tpu.models.ed25519 import (
+            Ed25519BatchVerifier,
+            Ed25519RandomizedBatchVerifier,
+        )
+        from consensus_tpu.testing.crypto_app import CryptoApp, SigOnlyVerifier
+
+        if self.crypto == "ed25519-batch":
+            # min_randomized=2 keeps quorum-sized batches on the randomized
+            # aggregate path even at chaos scale (n=4 certs).
+            engine = Ed25519RandomizedBatchVerifier(
+                min_device_batch=10**9, min_randomized=2
+            )
+        else:
+            engine = Ed25519BatchVerifier(min_device_batch=10**9)
+        signers = {
+            nid: Ed25519Signer(
+                nid,
+                hashlib.sha512(
+                    b"ctpu/chaos-key/%d/%d" % (self.schedule.seed, nid)
+                ).digest()[:32],
+            )
+            for nid in self.cluster.nodes
+        }
+        keys = {nid: s.public_bytes for nid, s in signers.items()}
+        for nid, node in self.cluster.nodes.items():
+            node.app = CryptoApp(
+                nid, self.cluster, signers[nid],
+                SigOnlyVerifier(keys, engine=engine),
+            )
+
     # --- the run ------------------------------------------------------------
 
     def run(self) -> ChaosResult:
@@ -369,6 +444,8 @@ class ChaosEngine:
             self.cluster.network.metrics = self.metrics.network
         if self.tracer is not None:
             self.cluster.network.tracer = self.tracer
+        if self.crypto is not None:
+            self._install_crypto()
         self.monitor = InvariantMonitor(
             self.cluster, check_durability=self.check_durability
         )

@@ -24,10 +24,29 @@ from __future__ import annotations
 import struct
 from typing import Mapping, Optional, Sequence
 
+from consensus_tpu.models.verifier import Ed25519VerifierMixin
 from consensus_tpu.testing.app import TestApp, pack_batch, unpack_batch
 from consensus_tpu.types import RequestInfo
 
 _REQ_TAG = b"ctpu/request"
+
+
+class SigOnlyVerifier(Ed25519VerifierMixin):
+    """Signature-only half of the Verifier port: the application half
+    (proposal/request semantics) lives in the app that wraps this —
+    CryptoApp delegates only the four signature paths here."""
+
+    def verify_proposal(self, proposal):
+        raise NotImplementedError  # app half lives in CryptoApp
+
+    def verify_request(self, raw):
+        raise NotImplementedError
+
+    def verification_sequence(self):
+        return 0
+
+    def requests_from_proposal(self, proposal):
+        return []
 
 
 class CryptoApp(TestApp):
@@ -37,6 +56,12 @@ class CryptoApp(TestApp):
         super().__init__(node_id, cluster)
         self._signer = signer
         self._verifier = verifier
+        # With a randomized batch engine behind the verifier, the Verifier
+        # base class coalesces multi-batch calls through this delegate in
+        # ONE launch (api/deps.py); strict engines keep the per-group loop
+        # bit-for-bit.
+        self.multi_batch_delegate = verifier
+        self.batch_verify_enabled = getattr(verifier, "batch_verify_enabled", False)
 
     # Signer
     def sign(self, data):
@@ -164,4 +189,4 @@ class SignedRequestApp(CryptoApp):
         return infos
 
 
-__all__ = ["CryptoApp", "SignedRequestApp", "ClientKeyring"]
+__all__ = ["CryptoApp", "SigOnlyVerifier", "SignedRequestApp", "ClientKeyring"]
