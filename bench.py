@@ -209,6 +209,58 @@ def bench_p256(msgs, sigs, keys) -> tuple[float, float]:
     return device_rate, host_rate
 
 
+#: Subprocess body for the structured-skip kernel-accounting probe: a tiny
+#: Ed25519 batch on the CPU backend, run twice so launches exceed compiles,
+#: printing the obs kernel registry as one JSON line.  Host-side compile /
+#: retrace trajectory stays observable even when the device is unreachable.
+_KERNEL_PROBE_CODE = """\
+import json
+from consensus_tpu.models import Ed25519Signer
+from consensus_tpu.models.ed25519 import Ed25519BatchVerifier
+from consensus_tpu.obs.kernels import KERNELS
+signer = Ed25519Signer(1, bytes([7]) * 32)
+msgs = [b"probe-%d" % i for i in range(8)]
+sigs = [signer.sign_raw(m) for m in msgs]
+keys = [signer.public_bytes] * 8
+v = Ed25519BatchVerifier(min_device_batch=1)
+assert v.verify_batch(msgs, sigs, keys).all()
+v.verify_batch(msgs, sigs, keys)
+print(json.dumps(KERNELS.snapshot()))
+"""
+
+
+def _kernel_accounting(source: str, per_kernel: dict) -> dict:
+    launches = sum(s.get("launches", 0) for s in per_kernel.values())
+    compiles = sum(s.get("compiles", 0) for s in per_kernel.values())
+    retraces = sum(s.get("retraces", 0) for s in per_kernel.values())
+    return {
+        "source": source,
+        "launches": launches,
+        "compiles": compiles,
+        "retraces": retraces,
+        "per_kernel": per_kernel,
+    }
+
+
+def _probe_kernel_accounting(timeout: float = PROBE_TIMEOUT):
+    """Kernel column family for the structured-skip path: run the tiny CPU
+    probe in a subprocess (JAX_PLATFORMS=cpu — no tunnel involved) and
+    return the accounting record, or None when even CPU jax is broken."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _KERNEL_PROBE_CODE],
+            timeout=timeout, capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            return None
+        per_kernel = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.TimeoutExpired, OSError, ValueError, IndexError):
+        return None
+    return _kernel_accounting("cpu-probe", per_kernel)
+
+
 def _probe_device_once(timeout: float = PROBE_TIMEOUT) -> bool:
     """Probe the device in a SUBPROCESS: a wedged tunnel hangs the probe
     process, not this one, and a later retry starts from a fresh backend
@@ -325,6 +377,7 @@ def main() -> None:
                 "skipped": "device-unavailable",
                 "last_good": dict(bv_last, stale=True) if bv_last else None,
             }
+        record["kernels"] = _probe_kernel_accounting()
         print(json.dumps(record))
         sys.exit(0)
 
@@ -359,6 +412,9 @@ def main() -> None:
             "unit": "sigs/sec",
             "vs_strict": round(batch_verify_rate / device_rate, 3),
         }
+    from consensus_tpu.obs.kernels import KERNELS
+
+    record["kernels"] = _kernel_accounting("live", KERNELS.snapshot())
     print(json.dumps(record))
     print(
         f"# backend={backend} batch={BATCH} device={device_rate:.0f}/s "

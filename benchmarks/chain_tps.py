@@ -40,9 +40,21 @@ jax.config.update("jax_platforms", "cpu")  # protocol-only bench: no device
 from benchmarks._harness import start_feeder, start_replicas, teardown
 from consensus_tpu.config import Configuration, TraceConfig
 from consensus_tpu.metrics import InMemoryProvider, Metrics
+from consensus_tpu.obs.export import render_watch
+from consensus_tpu.obs.sampler import ClusterSampler
 from consensus_tpu.testing.app import TestApp as PortsApp
 from consensus_tpu.testing.app import make_request
 from consensus_tpu.trace import build_report, format_table, write_chrome_trace
+
+
+class _WatchCluster:
+    """Duck-typed sampler target over the realtime harness: node 1's
+    scheduler drives the ticks, the Holders supply app/running, and the
+    leader's consensus + metrics are grafted on for the health fields."""
+
+    def __init__(self, scheduler, nodes):
+        self.scheduler = scheduler
+        self.nodes = nodes
 
 
 def _percentile(sorted_values: list[float], q: float) -> float:
@@ -53,7 +65,8 @@ def _percentile(sorted_values: list[float], q: float) -> float:
 
 
 def run_cell(
-    n: int, duration: float, depth: int, trace_path: str | None = None
+    n: int, duration: float, depth: int, trace_path: str | None = None,
+    watch: bool = False,
 ) -> dict:
     """One sweep cell: a fresh cluster at ``pipeline_depth=depth``.
 
@@ -107,6 +120,18 @@ def run_cell(
         make_wal=make_wal,
     )
 
+    sampler = None
+    if watch:
+        for nid, holder in cluster.nodes.items():
+            holder.consensus = replicas[nid]
+        cluster.nodes[1].metrics = replicas[1].metrics
+        sampler = ClusterSampler(
+            _WatchCluster(schedulers[1], cluster.nodes),
+            interval=0.5,
+            install_metrics=False,
+        )
+        sampler.start()
+
     leader = replicas[1]
     ledger = cluster.nodes[1].app.ledger
     stop, _exhausted = start_feeder(
@@ -133,6 +158,12 @@ def run_cell(
     end_tx = sum(int.from_bytes(d.proposal.payload[:4], "big") for d in ledger)
     window_lat = sorted(latencies()[start_lat:])
     stop.set()
+
+    if sampler is not None:
+        sampler.stop()
+        print(f"# watch: depth={depth} ({sampler.taken} samples @ "
+              f"{sampler.interval}s)", flush=True)
+        print(render_watch(sampler.samples()), flush=True)
 
     trace_report = None
     if trace_path is not None:
@@ -217,6 +248,12 @@ def main() -> None:
         help="write the leader's Chrome/Perfetto trace per depth and print "
         "the critical-path phase breakdown",
     )
+    parser.add_argument(
+        "--watch",
+        action="store_true",
+        help="sample cluster health during each cell and print terminal "
+        "sparklines (ledger height, pool occupancy, in-flight depth)",
+    )
     opts = parser.parse_args()
     n = opts.n
     duration = opts.seconds
@@ -229,6 +266,7 @@ def main() -> None:
             duration,
             depth,
             trace_path=_trace_path_for(opts.trace, depth, len(depths)),
+            watch=opts.watch,
         )
         results[depth] = cell
         print(json.dumps(cell), flush=True)

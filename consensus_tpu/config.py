@@ -28,6 +28,40 @@ class TraceConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Cluster observability plane knob (no reference counterpart).
+
+    Default-off, like :class:`TraceConfig`.  When enabled the test harness
+    (``testing.app.Cluster(obs=...)``) installs an in-memory metrics
+    provider on every node and arms a :class:`~consensus_tpu.obs.sampler.
+    ClusterSampler` on the shared scheduler: every ``sample_interval``
+    sim-seconds it snapshots each node's ``Metrics.dump()`` plus derived
+    health fields into a bounded ring of ``ring_capacity`` samples and
+    evaluates the anomaly detectors.  ``flight_samples`` bounds how many
+    trailing samples a flight-recorder bundle carries.
+    """
+
+    enabled: bool = False
+    sample_interval: float = 1.0
+    ring_capacity: int = 4096
+    flight_samples: int = 64
+    #: Optional ``consensus_tpu.obs.detectors.DetectorThresholds`` override
+    #: (held opaque here: config must not import the obs package).
+    detector_thresholds: object = None
+
+    def validate(self) -> None:
+        errs = []
+        if self.sample_interval <= 0:
+            errs.append("obs.sample_interval must be positive")
+        if self.ring_capacity < 1:
+            errs.append("obs.ring_capacity must be >= 1")
+        if self.flight_samples < 1:
+            errs.append("obs.flight_samples must be >= 1")
+        if errs:
+            raise ValueError("invalid configuration: " + "; ".join(errs))
+
+
+@dataclass(frozen=True)
 class Configuration:
     # --- identity -------------------------------------------------------
     self_id: int = 0
@@ -178,4 +212,4 @@ def default_config(self_id: int) -> Configuration:
     return cfg
 
 
-__all__ = ["Configuration", "TraceConfig", "default_config"]
+__all__ = ["Configuration", "ObsConfig", "TraceConfig", "default_config"]

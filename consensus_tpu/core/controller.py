@@ -179,6 +179,22 @@ class Controller:
             return False, 0
         return True, v.proposal_sequence
 
+    def health(self) -> dict:
+        """Derived health snapshot for the observability sampler
+        (consensus_tpu/obs/): everything is a plain read of existing state,
+        so sampling cannot perturb the protocol."""
+        active, seq = self.view_sequence()
+        v = self.curr_view
+        return {
+            "view": self.curr_view_number,
+            "leader": self.leader_id(),
+            "seq": seq,
+            "view_active": active,
+            "decisions_in_view": self.curr_decisions_in_view,
+            "in_flight": v.in_flight_depth() if v is not None else 0,
+            "syncing": self._sync_in_progress,
+        }
+
     # ----------------------------------------------------------- lifecycle
 
     def start(

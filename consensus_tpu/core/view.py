@@ -579,12 +579,19 @@ class View:
             self.self_id, seq, self.number, self.proposal_sequence,
         )
 
+    def in_flight_depth(self) -> int:
+        """Proposal slots currently moving through the 3-phase pipeline:
+        the oldest slot (when past PROPOSED) plus every processed pipelined
+        slot above it.  The same number the ``consensus_in_flight_depth``
+        gauge reports; public so the observability sampler can read it
+        without an in-memory metrics provider."""
+        depth = 1 if self.phase in (Phase.PROPOSED, Phase.PREPARED) else 0
+        return depth + sum(1 for slot in self._future.values() if slot.processed)
+
     def _update_inflight_depth(self) -> None:
         if self._consensus_metrics is None:
             return
-        depth = 1 if self.phase in (Phase.PROPOSED, Phase.PREPARED) else 0
-        depth += sum(1 for slot in self._future.values() if slot.processed)
-        self._consensus_metrics.in_flight_depth.set(depth)
+        self._consensus_metrics.in_flight_depth.set(self.in_flight_depth())
 
     # ------------------------------------------------------ phase machine
 

@@ -37,6 +37,52 @@ NET_INJECTED_KEYS = (
     NET_DROPPED_KEY, NET_DUPLICATED_KEY, NET_REORDERED_KEY, NET_REPLAYED_KEY,
 )
 
+#: Pinned instrument names for the observability plane (consensus_tpu/obs/).
+#: One counter per anomaly detector — the sampler bumps the affected node's
+#: counter the moment a detector fires (edge-triggered), mirrored by an
+#: ``obs.anomaly`` trace instant — plus the total sample count.  The chaos
+#: detector-soundness matrix asserts on these names.
+OBS_SAMPLES_KEY = "obs_samples_total"
+OBS_ANOMALY_COMMIT_STALL_KEY = "obs_anomaly_commit_stall"
+OBS_ANOMALY_VIEW_CHANGE_STORM_KEY = "obs_anomaly_view_change_storm"
+OBS_ANOMALY_LEADER_FLAP_KEY = "obs_anomaly_leader_flap"
+OBS_ANOMALY_SYNC_LAG_KEY = "obs_anomaly_sync_lag"
+OBS_ANOMALY_VERIFY_COLLAPSE_KEY = "obs_anomaly_verify_collapse"
+OBS_ANOMALY_KEYS = (
+    OBS_ANOMALY_COMMIT_STALL_KEY,
+    OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
+    OBS_ANOMALY_LEADER_FLAP_KEY,
+    OBS_ANOMALY_SYNC_LAG_KEY,
+    OBS_ANOMALY_VERIFY_COLLAPSE_KEY,
+)
+
+#: THE module-level registry of every pinned instrument name: key -> one-line
+#: description.  Tests and embedder dashboards key on this mapping; every
+#: name here is created by a fresh ``Metrics`` bundle (asserted by
+#: tests/test_obs.py), so a rename or a bundle regression breaks loudly in
+#: one place instead of silently stranding a dashboard.
+PINNED_METRIC_KEYS: dict[str, str] = {
+    VERIFY_LAUNCH_BATCH_KEY:
+        "commit signatures drained per batched verify launch (histogram)",
+    WAL_RECORDS_PER_FSYNC_KEY:
+        "group-commit coalescing ratio: WAL records per fsync (gauge)",
+    NET_DROPPED_KEY: "messages dropped by network injection",
+    NET_DUPLICATED_KEY: "messages delivered twice by network injection",
+    NET_REORDERED_KEY: "messages held back past later sends",
+    NET_REPLAYED_KEY: "stale captured messages re-delivered",
+    OBS_SAMPLES_KEY: "observability-plane samples taken",
+    OBS_ANOMALY_COMMIT_STALL_KEY:
+        "detector firings: pending work but no ledger growth",
+    OBS_ANOMALY_VIEW_CHANGE_STORM_KEY:
+        "detector firings: view number churning within the storm window",
+    OBS_ANOMALY_LEADER_FLAP_KEY:
+        "detector firings: leader identity churning within the flap window",
+    OBS_ANOMALY_SYNC_LAG_KEY:
+        "detector firings: ledger height diverging from the running peers",
+    OBS_ANOMALY_VERIFY_COLLAPSE_KEY:
+        "detector firings: ledger growth with zero verify launches",
+}
+
 
 class Counter(abc.ABC):
     @abc.abstractmethod
@@ -412,6 +458,50 @@ class MetricsNetwork(_Bundle):
         )
 
 
+class MetricsObs(_Bundle):
+    """Observability-plane instruments — consensus_tpu addition, fed by the
+    ``obs`` sampler/detectors (consensus_tpu/obs/).  One counter per anomaly
+    detector plus the sample count; the pinned names live in
+    :data:`PINNED_METRIC_KEYS` so they appear in a fresh ``Metrics.dump()``
+    even before the first sample."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_samples = p.new_counter(
+            OBS_SAMPLES_KEY, "Observability-plane samples taken.", ln
+        )
+        self.count_anomaly_commit_stall = p.new_counter(
+            OBS_ANOMALY_COMMIT_STALL_KEY,
+            "Commit-stall detector firings (pending work, no ledger growth).",
+            ln,
+        )
+        self.count_anomaly_view_change_storm = p.new_counter(
+            OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
+            "View-change-storm detector firings.",
+            ln,
+        )
+        self.count_anomaly_leader_flap = p.new_counter(
+            OBS_ANOMALY_LEADER_FLAP_KEY,
+            "Leader-flap detector firings.",
+            ln,
+        )
+        self.count_anomaly_sync_lag = p.new_counter(
+            OBS_ANOMALY_SYNC_LAG_KEY,
+            "Sync-lag-divergence detector firings.",
+            ln,
+        )
+        self.count_anomaly_verify_collapse = p.new_counter(
+            OBS_ANOMALY_VERIFY_COLLAPSE_KEY,
+            "Verify-launch-rate-collapse detector firings.",
+            ln,
+        )
+
+    def anomaly_counter(self, kind: str) -> Counter:
+        """The pinned counter for detector ``kind`` (its short name, e.g.
+        ``commit_stall``) — fails loudly on an unknown kind."""
+        return getattr(self, f"count_anomaly_{kind}")
+
+
 class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
@@ -448,6 +538,7 @@ class Metrics:
         self.wal = MetricsWAL(provider, label_names)
         self.sync = MetricsSync(provider, label_names)
         self.network = MetricsNetwork(provider, label_names)
+        self.obs = MetricsObs(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
         """Bind embedder label values on every bundle (e.g. the channel id).
@@ -479,6 +570,7 @@ __all__ = [
     "MetricsWAL",
     "MetricsSync",
     "MetricsNetwork",
+    "MetricsObs",
     "extend_label_names",
     "VERIFY_LAUNCH_BATCH_KEY",
     "WAL_RECORDS_PER_FSYNC_KEY",
@@ -487,4 +579,12 @@ __all__ = [
     "NET_REORDERED_KEY",
     "NET_REPLAYED_KEY",
     "NET_INJECTED_KEYS",
+    "OBS_SAMPLES_KEY",
+    "OBS_ANOMALY_COMMIT_STALL_KEY",
+    "OBS_ANOMALY_VIEW_CHANGE_STORM_KEY",
+    "OBS_ANOMALY_LEADER_FLAP_KEY",
+    "OBS_ANOMALY_SYNC_LAG_KEY",
+    "OBS_ANOMALY_VERIFY_COLLAPSE_KEY",
+    "OBS_ANOMALY_KEYS",
+    "PINNED_METRIC_KEYS",
 ]

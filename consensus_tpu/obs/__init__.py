@@ -1,0 +1,42 @@
+"""Cluster observability plane: sim-clock time-series sampling, health
+snapshots, anomaly detectors, exporters, a crash/invariant flight recorder,
+and device/kernel accounting.
+
+Always compiled, default off (like consensus_tpu/trace/).  See sampler.py
+(the scheduler-driven ring sampler + derived health fields), detectors.py
+(commit-stall / view-change storm / leader flap / sync-lag / verify-collapse),
+export.py (Prometheus text format v0.0.4, sorted-key JSONL, terminal
+sparklines), flightrec.py (atomic failure bundles + loader), kernels.py
+(jit compile/retrace/launch/cost accounting).
+"""
+
+from consensus_tpu.obs.detectors import Anomaly, DetectorThresholds
+from consensus_tpu.obs.export import (
+    sample_to_prometheus,
+    series_to_jsonl,
+    sparkline,
+    write_series_jsonl,
+)
+from consensus_tpu.obs.flightrec import (
+    FlightRecord,
+    FlightRecorder,
+    load_flight_record,
+)
+from consensus_tpu.obs.kernels import KERNELS, KernelRegistry, instrumented_jit
+from consensus_tpu.obs.sampler import ClusterSampler
+
+__all__ = [
+    "Anomaly",
+    "ClusterSampler",
+    "DetectorThresholds",
+    "FlightRecord",
+    "FlightRecorder",
+    "KERNELS",
+    "KernelRegistry",
+    "instrumented_jit",
+    "load_flight_record",
+    "sample_to_prometheus",
+    "series_to_jsonl",
+    "sparkline",
+    "write_series_jsonl",
+]

@@ -1,0 +1,219 @@
+"""Anomaly detectors evaluated at sample time.
+
+Each detector is a pure function of the health/metrics series the sampler
+accumulates — no wall clock, no randomness — so the anomaly stream of a
+fixed-seed run is byte-identical across replays.  Detectors are
+EDGE-TRIGGERED: a condition fires once at onset and re-arms only after the
+condition clears, so a 300-second stall is one anomaly, not 300.
+
+The five kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
+
+``commit_stall``        a running node has pending pool work but its ledger
+                        has not grown for ``stall_window`` sim-seconds
+``view_change_storm``   the node's view number advanced ``storm_views``+
+                        times within ``storm_window``
+``leader_flap``         the node's leader identity changed ``flap_changes``+
+                        times within ``flap_window``
+``sync_lag``            the node's ledger is ``lag_decisions``+ behind the
+                        tallest RUNNING peer
+``verify_collapse``     the ledger grew ``collapse_decisions``+ while the
+                        node's ``consensus_verify_launches`` counter stayed
+                        flat — decisions are appearing without commit-path
+                        verification work (e.g. a sync catch-up burst, or a
+                        verifier wedge)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional
+
+ANOMALY_KINDS = (
+    "commit_stall",
+    "view_change_storm",
+    "leader_flap",
+    "sync_lag",
+    "verify_collapse",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectorThresholds:
+    """Tuning knobs, all in sim-seconds / decision counts."""
+
+    stall_window: float = 30.0
+    storm_views: int = 3
+    storm_window: float = 60.0
+    flap_changes: int = 3
+    flap_window: float = 60.0
+    lag_decisions: int = 5
+    collapse_decisions: int = 3
+
+    def validate(self) -> None:
+        if self.stall_window <= 0 or self.storm_window <= 0 or self.flap_window <= 0:
+            raise ValueError("detector windows must be positive")
+        if min(self.storm_views, self.flap_changes,
+               self.lag_decisions, self.collapse_decisions) < 1:
+            raise ValueError("detector counts must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    """One detector firing, pinned to the sim clock."""
+
+    kind: str
+    node: int
+    sim_time: float
+    detail: str
+
+    def as_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "node": self.node,
+            "t": self.sim_time,
+            "detail": self.detail,
+        }
+
+
+class _NodeState:
+    """Per-node detector memory between samples."""
+
+    __slots__ = (
+        "stall_since", "last_ledger", "view_changes", "leader_changes",
+        "last_view", "last_leader", "collapse_base",
+    )
+
+    def __init__(self) -> None:
+        self.stall_since: Optional[float] = None
+        self.last_ledger = 0
+        self.view_changes: deque = deque()     # (t, view)
+        self.leader_changes: deque = deque()   # (t, leader)
+        self.last_view: Optional[int] = None
+        self.last_leader: Optional[int] = None
+        self.collapse_base: Optional[tuple[int, float]] = None  # (ledger, launches)
+
+
+class DetectorBank:
+    """Stateful evaluator: feed it one ``(t, {node: health}, {node: launches})``
+    tuple per sample, get the anomalies that FIRED at that sample."""
+
+    def __init__(self, thresholds: Optional[DetectorThresholds] = None) -> None:
+        self.thresholds = thresholds or DetectorThresholds()
+        self.thresholds.validate()
+        self._nodes: dict[int, _NodeState] = {}
+        #: (kind, node) pairs whose condition currently holds — the
+        #: edge-trigger latch.
+        self._active: set[tuple[str, int]] = set()
+
+    def _state(self, nid: int) -> _NodeState:
+        st = self._nodes.get(nid)
+        if st is None:
+            st = self._nodes[nid] = _NodeState()
+        return st
+
+    def _edge(self, fired: list, kind: str, nid: int, t: float,
+              condition: bool, detail: str) -> None:
+        key = (kind, nid)
+        if condition:
+            if key not in self._active:
+                self._active.add(key)
+                fired.append(Anomaly(kind=kind, node=nid, sim_time=t,
+                                     detail=detail))
+        else:
+            self._active.discard(key)
+
+    def evaluate(
+        self,
+        t: float,
+        health: dict,
+        launches: Optional[dict] = None,
+    ) -> list[Anomaly]:
+        """``health``: node id -> the sampler's health dict;
+        ``launches``: node id -> cumulative ``consensus_verify_launches``
+        (None / missing node skips the collapse detector)."""
+        th = self.thresholds
+        fired: list[Anomaly] = []
+        for nid in sorted(health):
+            h = health[nid]
+            st = self._state(nid)
+            running = h.get("running", False)
+            ledger = h.get("ledger", 0)
+
+            # --- commit stall ------------------------------------------
+            if not running or h.get("pool", 0) <= 0 or ledger > st.last_ledger:
+                st.stall_since = None
+            elif st.stall_since is None:
+                st.stall_since = t
+            stalled = (
+                st.stall_since is not None
+                and t - st.stall_since >= th.stall_window
+            )
+            self._edge(
+                fired, "commit_stall", nid, t, stalled,
+                f"ledger stuck at {ledger} with pending pool work for "
+                f">= {th.stall_window:g}s",
+            )
+            st.last_ledger = max(st.last_ledger, ledger)
+
+            # --- view-change storm -------------------------------------
+            view = h.get("view", -1)
+            if running and view >= 0:
+                if st.last_view is not None and view != st.last_view:
+                    st.view_changes.append((t, view))
+                st.last_view = view
+            while st.view_changes and t - st.view_changes[0][0] > th.storm_window:
+                st.view_changes.popleft()
+            self._edge(
+                fired, "view_change_storm", nid, t,
+                len(st.view_changes) >= th.storm_views,
+                f"{len(st.view_changes)} view changes within "
+                f"{th.storm_window:g}s (now at view {view})",
+            )
+
+            # --- leader flap -------------------------------------------
+            leader = h.get("leader", -1)
+            if running and leader >= 0:
+                if st.last_leader is not None and leader != st.last_leader:
+                    st.leader_changes.append((t, leader))
+                st.last_leader = leader
+            while st.leader_changes and t - st.leader_changes[0][0] > th.flap_window:
+                st.leader_changes.popleft()
+            self._edge(
+                fired, "leader_flap", nid, t,
+                len(st.leader_changes) >= th.flap_changes,
+                f"{len(st.leader_changes)} leader changes within "
+                f"{th.flap_window:g}s (now following {leader})",
+            )
+
+            # --- sync-lag divergence -----------------------------------
+            lag = h.get("sync_lag", 0)
+            self._edge(
+                fired, "sync_lag", nid, t, lag >= th.lag_decisions,
+                f"{lag} decisions behind the tallest running peer",
+            )
+
+            # --- verify-launch-rate collapse ---------------------------
+            nl = (launches or {}).get(nid)
+            if nl is None:
+                st.collapse_base = None
+                self._active.discard(("verify_collapse", nid))
+            else:
+                if st.collapse_base is None or nl > st.collapse_base[1]:
+                    st.collapse_base = (ledger, nl)
+                grown = ledger - st.collapse_base[0]
+                self._edge(
+                    fired, "verify_collapse", nid, t,
+                    grown >= th.collapse_decisions,
+                    f"ledger grew {grown} decisions with zero verify "
+                    f"launches (counter flat at {nl:g})",
+                )
+        return fired
+
+
+__all__ = [
+    "ANOMALY_KINDS",
+    "Anomaly",
+    "DetectorBank",
+    "DetectorThresholds",
+]

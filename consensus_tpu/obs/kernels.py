@@ -1,0 +1,140 @@
+"""Device/kernel accounting: compiles, retraces, launches, and cost
+estimates per jit entry point.
+
+:func:`instrumented_jit` replaces the bare ``jax.jit(fn)`` at the module
+level of the signature models (ed25519 verify / batch-verify, ecdsa-p256
+verify).  The wrapper is transparent — same signature, same outputs — and
+on every call records into the process-wide :data:`KERNELS` registry:
+
+* ``launches``   — calls into the jitted function;
+* ``compiles``   — jit cache growth observed across calls (via the private
+  but long-stable ``_cache_size`` probe; gracefully 0 if it disappears);
+* ``retraces``   — compiles beyond the first, i.e. shape/dtype churn;
+* ``flops`` / ``bytes_accessed`` — XLA cost-analysis estimates captured at
+  first compile per kernel (``lower(...).cost_analysis()``; ``lower`` does
+  not populate the jit call cache, so the probe never double-compiles).
+
+The registry is surfaced as a ``kernels`` column family in bench.py on both
+the live and structured-skip paths.
+
+jax is imported lazily inside the wrapper so importing consensus_tpu.obs
+never drags in the accelerator stack (the sim plane must stay importable
+on boxes without jax).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class KernelStats:
+    """Mutable per-kernel counters."""
+
+    __slots__ = ("name", "launches", "compiles", "flops", "bytes_accessed")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.launches = 0
+        self.compiles = 0
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+
+    @property
+    def retraces(self) -> int:
+        return max(0, self.compiles - 1)
+
+    def as_dict(self) -> dict:
+        return {
+            "launches": self.launches,
+            "compiles": self.compiles,
+            "retraces": self.retraces,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+        }
+
+
+class KernelRegistry:
+    """Process-wide map of kernel name -> :class:`KernelStats`."""
+
+    def __init__(self) -> None:
+        self._stats: dict[str, KernelStats] = {}
+
+    def stats(self, name: str) -> KernelStats:
+        st = self._stats.get(name)
+        if st is None:
+            st = self._stats[name] = KernelStats(name)
+        return st
+
+    def snapshot(self) -> dict:
+        """``{kernel: {launches, compiles, retraces, flops, bytes_accessed}}``,
+        sorted, JSON-ready.  Empty dict when nothing has launched."""
+        return {
+            name: self._stats[name].as_dict() for name in sorted(self._stats)
+        }
+
+    def totals(self) -> dict:
+        snap = self.snapshot()
+        return {
+            "launches": sum(s["launches"] for s in snap.values()),
+            "compiles": sum(s["compiles"] for s in snap.values()),
+            "retraces": sum(s["retraces"] for s in snap.values()),
+        }
+
+    def reset(self) -> None:
+        self._stats.clear()
+
+
+#: The process-wide registry bench.py snapshots.
+KERNELS = KernelRegistry()
+
+
+def _cache_size(jitted) -> int:
+    try:
+        return int(jitted._cache_size())
+    except Exception:
+        return 0
+
+
+def _cost_number(analysis, key: str) -> Optional[float]:
+    # cost_analysis() is a flat dict on current jax; older versions returned
+    # a one-element list of dicts.
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    v = analysis.get(key)
+    return float(v) if v is not None else None
+
+
+def instrumented_jit(fn, name: str, *, registry: Optional[KernelRegistry] = None):
+    """``jax.jit(fn)`` plus accounting under ``name``.  Behaves exactly like
+    the jitted function; every failure inside the accounting is swallowed so
+    instrumentation can never break a verify path."""
+    import jax
+
+    jitted = jax.jit(fn)
+    reg = registry if registry is not None else KERNELS
+
+    def wrapper(*args, **kwargs):
+        st = reg.stats(name)
+        st.launches += 1
+        before = _cache_size(jitted)
+        out = jitted(*args, **kwargs)
+        grew = _cache_size(jitted) - before
+        if grew > 0:
+            st.compiles += grew
+            if st.flops is None:
+                try:
+                    analysis = jitted.lower(*args, **kwargs).cost_analysis()
+                    st.flops = _cost_number(analysis, "flops")
+                    st.bytes_accessed = _cost_number(analysis, "bytes accessed")
+                except Exception:
+                    pass
+        return out
+
+    wrapper.__name__ = f"instrumented_{name}"
+    wrapper.__wrapped__ = jitted
+    return wrapper
+
+
+__all__ = ["KERNELS", "KernelRegistry", "KernelStats", "instrumented_jit"]

@@ -33,6 +33,7 @@ from consensus_tpu.models.ed25519 import (
     to_kernel_layout,
     verify_impl,
 )
+from consensus_tpu.obs.kernels import instrumented_jit
 
 BATCH_AXIS = "batch"
 
@@ -94,7 +95,7 @@ def sharded_verify_fn(mesh: Mesh):
         total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
         return ok, total
 
-    return jax.jit(_shard)
+    return instrumented_jit(_shard, "ed25519.sharded_verify")
 
 
 class ShardedEd25519Verifier(Ed25519BatchVerifier):
@@ -174,7 +175,7 @@ def sharded_p256_verify_fn(mesh: Mesh):
         total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
         return ok, total
 
-    return jax.jit(_shard)
+    return instrumented_jit(_shard, "ecdsa_p256.sharded_verify")
 
 
 class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):

@@ -100,6 +100,12 @@ class SimScheduler:
         self._now = start
         self._heap: list[TimerHandle] = []
         self._seq = itertools.count()
+        #: Optional observer called as ``hook(event_name, exception)`` when
+        #: an event handler raises — the flight recorder's trigger seam for
+        #: unhandled controller exceptions (consensus_tpu/obs/flightrec.py).
+        #: The exception is still swallowed (components must stay isolated
+        #: from each other's failures); the hook only *observes* it.
+        self.on_unhandled_error: Optional[Callable[[str, BaseException], None]] = None
 
     # --- Scheduler protocol ------------------------------------------------
 
@@ -126,10 +132,16 @@ class SimScheduler:
             return
         try:
             fn()
-        except Exception:
+        except Exception as err:
             # A crashing handler must not wedge the whole simulation; real
             # components are expected to catch their own errors.
             logger.exception("unhandled error in event %r", h.name)
+            hook = self.on_unhandled_error
+            if hook is not None:
+                try:
+                    hook(h.name, err)
+                except Exception:
+                    logger.exception("on_unhandled_error hook failed")
 
     def _drain(
         self,
@@ -219,6 +231,8 @@ class RealtimeScheduler:
         self._cond = threading.Condition()
         self._stopped = False
         self._thread: Optional[threading.Thread] = None
+        #: Same contract as ``SimScheduler.on_unhandled_error``.
+        self.on_unhandled_error: Optional[Callable[[str, BaseException], None]] = None
 
     def now(self) -> float:
         return _time.monotonic()
@@ -282,8 +296,14 @@ class RealtimeScheduler:
                 continue
             try:
                 fn()
-            except Exception:
+            except Exception as err:
                 logger.exception("unhandled error in event %r", h.name)
+                hook = self.on_unhandled_error
+                if hook is not None:
+                    try:
+                        hook(h.name, err)
+                    except Exception:
+                        logger.exception("on_unhandled_error hook failed")
 
 
 __all__ = [

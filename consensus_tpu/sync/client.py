@@ -110,6 +110,9 @@ class LedgerSynchronizer(Synchronizer):
         self.threshold = threshold
         #: Peer scores persist across sync() calls (higher is better).
         self.scores: Dict[int, float] = {}
+        #: Height of the tallest probed peer on the most recent sync() call
+        #: — the obs plane's sync-lag source (0 until a sync runs).
+        self.last_target_height = 0
 
     def attach_tracer(self, tracer) -> None:
         """Emit chunk fetch/verify spans into a decision tracer."""
@@ -149,6 +152,7 @@ class LedgerSynchronizer(Synchronizer):
             elif isinstance(reply, SyncChunk):
                 heights[peer] = reply.height
         target = max(heights.values(), default=0)
+        self.last_target_height = target
 
         # Phase 2: chunk-fetch loop.  The target is pinned to the probed
         # maximum — a byzantine peer inflating `height` in later chunks

@@ -462,6 +462,7 @@ class Cluster:
         wal_dir: Optional[str] = None,
         wal_segment_bytes: int = 2048,
         sync_mode: str = "wire",
+        obs=None,
     ) -> None:
         #: > 0 gives every node group-commit durability semantics
         #: (DeferredMemWAL): appends become durable — and their deferred
@@ -504,10 +505,27 @@ class Cluster:
             )
             tweaks = dict(config_tweaks or {})  # fresh copy per node
             self.nodes[node_id] = Node(node_id, self, cfg)
+        #: Observability plane — DEFAULT OFF.  Pass an ``ObsConfig`` with
+        #: ``enabled=True`` to build a ClusterSampler here (pre-start, so
+        #: the installed metrics providers reach the Consensus builds) and
+        #: arm it in :meth:`start`.
+        self.sampler = None
+        if obs is not None and obs.enabled:
+            obs.validate()
+            from consensus_tpu.obs.sampler import ClusterSampler
+
+            self.sampler = ClusterSampler(
+                self,
+                interval=obs.sample_interval,
+                capacity=obs.ring_capacity,
+                thresholds=obs.detector_thresholds,
+            )
 
     def start(self) -> None:
         for node in self.nodes.values():
             node.start()
+        if self.sampler is not None:
+            self.sampler.start()
 
     # --- app-level cluster state ------------------------------------------
 

@@ -200,6 +200,14 @@ class ChaosResult:
     ledgers: dict
     schedule: ChaosSchedule
     deliveries: int
+    #: Every obs-plane detector firing (obs.Anomaly), in sim-time order.
+    #: Empty unless the engine ran with ``obs`` enabled.
+    anomalies: tuple = ()
+    #: Final per-node health snapshot ({node id (str): health dict}) from
+    #: the sampler's last sample.  Empty without ``obs``.
+    final_health: dict = dataclasses.field(default_factory=dict)
+    #: Flight-recorder bundle path, when a recorder was armed AND triggered.
+    flightrec_path: Optional[str] = None
 
 
 class ChaosEngine:
@@ -226,6 +234,8 @@ class ChaosEngine:
         metrics=None,
         tracer=None,
         crypto: Optional[str] = None,
+        obs=None,
+        flight_dir: Optional[str] = None,
     ) -> None:
         """``crypto`` arms REAL Ed25519 on every replica signature path:
         ``"ed25519"`` uses the strict batch engine, ``"ed25519-batch"`` the
@@ -243,6 +253,16 @@ class ChaosEngine:
         self.metrics = metrics
         self.tracer = tracer
         self.crypto = crypto
+        #: Observability plane: an ``ObsConfig`` (enabled=True) samples the
+        #: cluster during the run; detector firings land in the event log
+        #: as ANOMALY lines and on ``ChaosResult.anomalies``.  Sampling is
+        #: read-only, so ledgers are byte-identical with or without it.
+        self.obs = obs
+        #: Directory for flight-recorder bundles; None leaves the recorder
+        #: unarmed.  Requires ``obs`` for sample/health capture but works
+        #: without it (trace + schedule only).
+        self.flight_dir = flight_dir
+        self.recorder = None
         self.cluster: Optional[Cluster] = None
         self.monitor: Optional[InvariantMonitor] = None
         self._log: list[str] = []
@@ -335,6 +355,8 @@ class ChaosEngine:
             plan = FaultPlan(args["point"], on_hit=args["hit"],
                              label=f"chaos@{action.at:.4f}")
             node.arm_fault_plan(plan)
+            if self.recorder is not None:
+                self.recorder.watch_plan(plan)
             return True
         raise ValueError(f"unknown chaos action kind {kind!r}")
 
@@ -439,6 +461,7 @@ class ChaosEngine:
             seed=sched.seed ^ 0xCA05,
             config_tweaks=self.config_tweaks,
             durability_window=sched.durability_window,
+            obs=self.obs,
         )
         if self.metrics is not None:
             self.cluster.network.metrics = self.metrics.network
@@ -449,6 +472,34 @@ class ChaosEngine:
         self.monitor = InvariantMonitor(
             self.cluster, check_durability=self.check_durability
         )
+        sampler = self.cluster.sampler
+        if sampler is not None:
+            if self.tracer is not None:
+                sampler.tracer = self.tracer
+            # Detector firings land in the deterministic event log with the
+            # same sim-time stamp format as adversary actions.
+            sampler.on_anomaly.append(
+                lambda a: self._emit(
+                    f"{a.sim_time:10.4f} ANOMALY {a.kind} node={a.node} "
+                    f"{a.detail}"
+                )
+            )
+        if self.flight_dir is not None:
+            from consensus_tpu.obs.flightrec import FlightRecorder
+
+            self.recorder = FlightRecorder(
+                seed=sched.seed,
+                out_dir=self.flight_dir,
+                clock=self.cluster.scheduler.now,
+                sampler=sampler,
+                tracer=self.tracer,
+                schedule=sched,
+                last_n=(
+                    self.obs.flight_samples if self.obs is not None else 64
+                ),
+            )
+            self.recorder.attach_scheduler(self.cluster.scheduler)
+            self.recorder.attach_monitor(self.monitor)
         self.cluster.start()
         self._emit(f"{self._now():10.4f} start n={sched.n} seed={sched.seed} "
                    f"window={sched.durability_window!r}")
@@ -530,6 +581,11 @@ class ChaosEngine:
             tail = ",".join(digests[-3:])
             self._emit(f"{self._now():10.4f} ledger {nid} "
                        f"height={len(digests)} tail={tail}")
+        sampler = self.cluster.sampler
+        if sampler is not None:
+            # One closing sample so the final health snapshot reflects the
+            # post-quiesce state (deterministic: always exactly here).
+            sampler.sample_now()
         return ChaosResult(
             ok=violation is None,
             violation=violation,
@@ -537,6 +593,11 @@ class ChaosEngine:
             ledgers=ledgers,
             schedule=sched,
             deliveries=self.monitor.deliveries,
+            anomalies=tuple(sampler.anomalies) if sampler is not None else (),
+            final_health=sampler.latest_health() if sampler is not None else {},
+            flightrec_path=(
+                self.recorder.path if self.recorder is not None else None
+            ),
         )
 
 

@@ -164,6 +164,10 @@ class FaultPlan:
         #: emits a ``fault.fired`` instant so a trace shows exactly which
         #: seam fired, on the same deterministic clock as the phase spans.
         self.tracer = None
+        #: Optional flight recorder (obs.FlightRecorder): the armed hit calls
+        #: ``recorder.on_fault_fired(point, hit)`` BEFORE on_crash tears the
+        #: node down, so the bundle captures the pre-crash state.
+        self.recorder = None
         self._lock = threading.Lock()
 
     def _count_hit(self, point: str, *, die: bool) -> tuple[bool, int]:
@@ -192,6 +196,12 @@ class FaultPlan:
             tracer = self.tracer
             if tracer is not None and tracer.enabled:
                 tracer.instant("fault", "fault.fired", point=point, hit=n)
+            recorder = self.recorder
+            if recorder is not None:
+                try:
+                    recorder.on_fault_fired(point, n)
+                except Exception:
+                    pass
         return armed, n
 
     def trip(self, point: str) -> bool:

@@ -113,6 +113,10 @@ class InvariantMonitor:
         #: Adversary-action lines the chaos engine appends as it executes
         #: the schedule; snapshotted into each violation.
         self.history: list[str] = []
+        #: ``fn(Violation)`` called the moment a violation is recorded —
+        #: the flight recorder hangs here to dump while the failing state
+        #: is still live.  Hook failures must not mask the violation.
+        self.on_violation: list = []
         self.deliveries = 0
         cluster.delivery_hooks.append(self._on_deliver)
 
@@ -123,15 +127,19 @@ class InvariantMonitor:
         return self.violations[0] if self.violations else None
 
     def record(self, invariant: str, node: Optional[int], detail: str) -> None:
-        self.violations.append(
-            Violation(
-                invariant=invariant,
-                sim_time=self.cluster.scheduler.now(),
-                node=node,
-                detail=detail,
-                history=tuple(self.history),
-            )
+        violation = Violation(
+            invariant=invariant,
+            sim_time=self.cluster.scheduler.now(),
+            node=node,
+            detail=detail,
+            history=tuple(self.history),
         )
+        self.violations.append(violation)
+        for hook in self.on_violation:
+            try:
+                hook(violation)
+            except Exception:
+                pass
 
     def assert_clean(self) -> None:
         if self.violations:

@@ -16,9 +16,18 @@ Examples:
     python scripts/chaos_sweep.py --start 4000 --count 1000 \\
         --shrink-on-failure --json-out /tmp/sweep.json
 
+Every seed runs with the observability plane sampling (read-only: ledgers
+and verdicts are identical to an unsampled run) and emits one per-seed JSON
+line with its anomaly-detector counts and the final health snapshot of
+every node:
+
+    {"seed": S, "ok": true, "anomalies": {"sync_lag": 2, ...},
+     "health": {"1": {"view": ..., "ledger": ..., ...}, ...}}
+
 The final stdout line is always a single JSON object:
 
-    {"swept": N, "failed": K, "seeds_failed": [...], "params": {...}}
+    {"swept": N, "failed": K, "seeds_failed": [...], "anomalies": {...},
+     "params": {...}}
 
 Exit status: 0 when every seed passes, 1 otherwise.
 """
@@ -31,6 +40,7 @@ import sys
 
 sys.path.insert(0, ".")  # runnable from the repo root without installing
 
+from consensus_tpu.config import ObsConfig  # noqa: E402
 from consensus_tpu.testing.chaos import (  # noqa: E402
     ChaosEngine,
     ChaosSchedule,
@@ -41,12 +51,27 @@ from consensus_tpu.testing.chaos import (  # noqa: E402
 
 def run_sweep(args) -> int:
     failed: list[int] = []
+    anomaly_totals: dict[str, int] = {}
+    obs = ObsConfig(enabled=True, sample_interval=args.sample_interval)
     for seed in range(args.start, args.start + args.count):
         schedule = ChaosSchedule.generate(
             seed, n=args.nodes, steps=args.steps,
             durability_window=args.window,
         )
-        result = ChaosEngine(schedule).run()
+        result = ChaosEngine(schedule, obs=obs).run()
+        counts: dict[str, int] = {}
+        for a in result.anomalies:
+            counts[a.kind] = counts.get(a.kind, 0) + 1
+            anomaly_totals[a.kind] = anomaly_totals.get(a.kind, 0) + 1
+        print(json.dumps(
+            {
+                "seed": seed,
+                "ok": result.ok,
+                "anomalies": dict(sorted(counts.items())),
+                "health": result.final_health,
+            },
+            sort_keys=True,
+        ))
         if result.ok:
             if args.verbose:
                 height = max(len(d) for d in result.ledgers.values())
@@ -72,6 +97,7 @@ def run_sweep(args) -> int:
         "swept": args.count,
         "failed": len(failed),
         "seeds_failed": failed,
+        "anomalies": dict(sorted(anomaly_totals.items())),
         "params": {
             "start": args.start,
             "nodes": args.nodes,
@@ -98,6 +124,8 @@ def main() -> int:
                     help="adversary actions per schedule")
     ap.add_argument("--window", type=float, default=0.0,
                     help="group-commit durability window (sim seconds)")
+    ap.add_argument("--sample-interval", type=float, default=5.0,
+                    help="obs-plane sampling interval (sim seconds)")
     ap.add_argument("--shrink-on-failure", action="store_true",
                     help="ddmin failing schedules to minimal reproducers")
     ap.add_argument("--shrink-budget", type=int, default=200,

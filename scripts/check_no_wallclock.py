@@ -2,9 +2,13 @@
 """Lint: no wall-clock reads inside consensus_tpu/ outside the scheduler.
 
 Determinism (and therefore replayable traces, reproducible crash matrices,
-and byte-identical exported span streams) depends on every timestamp in the
-protocol coming from the injected Scheduler clock.  This script walks the
-package AST and fails on any *call* to:
+byte-identical exported span streams, AND the observability plane's
+byte-identical sample series / Prometheus exports) depends on every
+timestamp in the protocol coming from the injected Scheduler clock.  The
+walk covers the whole package — consensus_tpu/obs/ (sampler, detectors,
+exporters, flight recorder) included; tests/test_no_wallclock.py pins that
+coverage so the obs plane can never silently pick up a wall-clock read.
+This script walks the package AST and fails on any *call* to:
 
   - ``time.time()``
   - ``time.monotonic()``

@@ -42,6 +42,26 @@ def test_lint_catches_a_violation(tmp_path):
     assert "bad.py:2: time.time()" in proc.stdout
 
 
+def test_lint_covers_obs_plane():
+    """The observability plane (sampler/detectors/exporters/flight recorder)
+    claims byte-identical fixed-seed exports; that claim dies the moment a
+    wall-clock read slips in.  Run the lint rooted AT consensus_tpu/obs/ so
+    the plane's coverage is pinned independently of the package-wide walk,
+    and assert the expected modules are actually there to be walked."""
+    obs_dir = os.path.join(_REPO, "consensus_tpu", "obs")
+    present = {f for f in os.listdir(obs_dir) if f.endswith(".py")}
+    assert {"sampler.py", "detectors.py", "export.py",
+            "flightrec.py", "kernels.py"} <= present
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, obs_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        "obs plane has wall-clock reads:\n" + proc.stdout + proc.stderr
+    )
+
+
 def test_lint_honors_wallclock_ok_marker(tmp_path):
     (tmp_path / "audited.py").write_text(
         "import time\ndeadline = time.monotonic()  # wallclock-ok\n",

@@ -162,6 +162,61 @@ def test_jsonl_export_one_valid_object_per_event():
         assert isinstance(obj["ts"], float)
 
 
+# --- critical path under pipelining ----------------------------------------
+
+
+def test_critical_path_report_with_pipelined_decisions_in_flight():
+    """The report's FIFO pool-admit -> batch-seal matching must stay exact
+    when ``pipeline_depth > 1`` keeps several decisions in flight: every
+    decision still gets a ``pool_wait``/``seal_wait`` attribution, seals
+    never consume more admits than the leader recorded, and the chains all
+    complete."""
+    decisions = 24
+    cluster = Cluster(
+        4,
+        seed=41,
+        config_tweaks=_traced_tweaks(
+            pipeline_depth=4,
+            request_batch_max_count=2,
+            request_batch_max_interval=0.005,
+        ),
+    )
+    cluster.start()
+    for i in range(decisions * 2):  # two requests per sealed batch
+        cluster.submit_to_all(make_request("pipe", i))
+    assert cluster.run_until_ledger(decisions, max_time=120.0)
+
+    events = cluster.nodes[1].consensus.tracer.events()  # the static leader
+    # The window genuinely overlapped: decision spans were concurrently
+    # open, so FIFO matching ran against interleaved admits and seals.
+    open_now = max_open = 0
+    for ph, _track, name, _ts, _seq, _view, _args in events:
+        if name == "decision":
+            open_now += 1 if ph == "B" else -1
+            max_open = max(max_open, open_now)
+    assert max_open > 1, "depth=4 run never pipelined"
+
+    report = build_report(events)
+    assert report["n_decisions"] == decisions
+    assert report["n_complete"] == decisions
+    percentiles = report["phase_percentiles"]
+    for phase in ("pool_wait", "seal_wait"):
+        assert percentiles[phase]["n"] == decisions
+        assert percentiles[phase]["p50"] >= 0.0
+    for d in report["decisions"].values():
+        assert d["phases"]["pool_wait"] >= 0.0
+        assert d["phases"]["seal_wait"] >= 0.0
+    admits = sum(
+        1 for ev in events if ev[0] == "i" and ev[2] == "pool.admit"
+    )
+    sealed = sum(
+        (ev[6] or {}).get("count", 1)
+        for ev in events
+        if ev[0] == "i" and ev[2] == "batch.seal"
+    )
+    assert sealed <= admits, "seals consumed admits that never happened"
+
+
 # --- crash-matrix visibility ----------------------------------------------
 
 
