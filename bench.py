@@ -137,6 +137,52 @@ def bench_batch_verify(msgs, sigs, keys) -> float:
     return len(msgs) * DEVICE_ITERS / (time.perf_counter() - start)
 
 
+#: shards × batch sweep for the mesh_verify column family.  Shard counts
+#: are filtered to the devices actually visible (a v5e-1 reports the 1-shard
+#: row only; a host mesh with XLA_FLAGS=--xla_force_host_platform_device_count
+#: fills the sweep on CPU).
+MESH_SHARD_SWEEP = (1, 2, 4, 8)
+MESH_BATCH_SWEEP = (2048, 16384)
+
+
+def bench_mesh_verify(msgs, sigs, keys) -> dict:
+    """``mesh_verify`` column family: the sharded strict engine
+    (parallel/sharding.py shard_map lane) timed through ``verify_batch``
+    across a shards × batch sweep.  The headline ``value`` is the largest
+    shard count at the largest batch; ``vs_single_shard`` answers "what did
+    the mesh buy over one device at the same batch"."""
+    import jax
+
+    from consensus_tpu.parallel.sharding import (
+        ShardedEd25519Verifier,
+        mesh_for_shards,
+    )
+
+    n_dev = len(jax.devices())
+    shard_counts = [s for s in MESH_SHARD_SWEEP if s <= n_dev] or [1]
+    batches = sorted({min(b, len(msgs)) for b in MESH_BATCH_SWEEP})
+    sweep = {}
+    for shards in shard_counts:
+        verifier = ShardedEd25519Verifier(mesh_for_shards(shards))
+        for batch in batches:
+            m, s, k = msgs[:batch], sigs[:batch], keys[:batch]
+            ok = verifier.verify_batch(m, s, k)  # warmup compile per shape
+            assert ok.all(), "benchmark signatures must verify"
+            start = time.perf_counter()
+            for _ in range(DEVICE_ITERS):
+                assert verifier.verify_batch(m, s, k).all()
+            elapsed = time.perf_counter() - start
+            sweep[f"{shards}x{batch}"] = batch * DEVICE_ITERS / elapsed
+    head = sweep[f"{shard_counts[-1]}x{batches[-1]}"]
+    single = sweep[f"1x{batches[-1]}"]
+    return {
+        "sweep": {key: round(rate, 1) for key, rate in sweep.items()},
+        "value": round(head, 1),
+        "unit": "sigs/sec",
+        "vs_single_shard": round(head / single, 3),
+    }
+
+
 def bench_host(msgs, sigs, keys) -> float:
     from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
 
@@ -377,6 +423,11 @@ def main() -> None:
                 "skipped": "device-unavailable",
                 "last_good": dict(bv_last, stale=True) if bv_last else None,
             }
+            mesh_last = _load_last_good("ed25519_mesh_verify_throughput")
+            record["mesh_verify"] = {
+                "skipped": "device-unavailable",
+                "last_good": dict(mesh_last, stale=True) if mesh_last else None,
+            }
         record["kernels"] = _probe_kernel_accounting()
         print(json.dumps(record))
         sys.exit(0)
@@ -385,6 +436,7 @@ def main() -> None:
 
     backend = jax.default_backend()
     batch_verify_rate = None
+    mesh_record = None
     if metric == "ecdsa_p256_verify_throughput":
         msgs, sigs, keys = make_p256_signatures(BATCH)
         device_rate, host_rate = bench_p256(msgs, sigs, keys)
@@ -399,6 +451,12 @@ def main() -> None:
                 batch_verify_rate,
                 batch_verify_rate / device_rate,
             )
+            mesh_record = bench_mesh_verify(msgs, sigs, keys)
+            _save_last_good(
+                "ed25519_mesh_verify_throughput",
+                mesh_record["value"],
+                mesh_record["vs_single_shard"],
+            )
     _save_last_good(metric, device_rate, device_rate / host_rate)
     record = {
         "metric": metric,
@@ -412,6 +470,8 @@ def main() -> None:
             "unit": "sigs/sec",
             "vs_strict": round(batch_verify_rate / device_rate, 3),
         }
+    if mesh_record is not None:
+        record["mesh_verify"] = mesh_record
     from consensus_tpu.obs.kernels import KERNELS
 
     record["kernels"] = _kernel_accounting("live", KERNELS.snapshot())
@@ -422,6 +482,11 @@ def main() -> None:
         + (
             f" batch-verify={batch_verify_rate:.0f}/s"
             if batch_verify_rate is not None
+            else ""
+        )
+        + (
+            f" mesh-verify={mesh_record['value']:.0f}/s"
+            if mesh_record is not None
             else ""
         ),
         file=sys.stderr,

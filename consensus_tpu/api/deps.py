@@ -208,6 +208,28 @@ class Verifier(abc.ABC):
             for proposal, sigs in groups
         ]
 
+    def verify_proposal_and_prev_commits(
+        self,
+        proposal: Proposal,
+        prev_commits: Sequence[Signature],
+        prev_proposal: Proposal,
+    ) -> tuple[Sequence[RequestInfo], list[Optional[bytes]]]:
+        """Verify a proposal AND the previous decision's commit-signature
+        quorum it carries — the two signature waves of one pre-prepare.
+
+        Default runs them as two calls (exactly the split the core did
+        before this entry point existed).  Verifiers whose request
+        signatures and consenter certs share one engine override this to
+        fuse both waves into a single launch; any request failure must
+        still raise exactly as ``verify_proposal`` would, BEFORE cert
+        results are consumed.
+        """
+        requests = self.verify_proposal(proposal)
+        if not prev_commits:
+            return requests, []
+        cert_results = self.verify_consenter_sigs_batch(prev_commits, prev_proposal)
+        return requests, cert_results
+
 
 # Convenience alias for implementations that only provide the batch forms.
 BatchVerifier = Verifier

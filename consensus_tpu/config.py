@@ -132,6 +132,13 @@ class Configuration:
     # torsion-component signatures can differ from the strict kernel's
     # (SAFETY.md §7).
     batch_verify_mode: bool = False
+    # Device-mesh width for the batch engine (parallel/sharding.py): 1 keeps
+    # today's single-device engines bit-for-bit; >1 selects the sharded
+    # engines (shard_map over a 1-D mesh, batch axis partitioned, validity
+    # reduced with one psum).  All replicas in a cluster may pick DIFFERENT
+    # shard counts freely — sharding changes only the launch topology, never
+    # the verdict (the host-mesh parity gate pins this).
+    mesh_shards: int = 1
 
     # --- decision-lifecycle tracing (no reference counterpart) ----------
     trace: TraceConfig = field(default=TraceConfig())
@@ -191,6 +198,10 @@ class Configuration:
             errs.append("decisions_per_leader must be zero when rotation is off")
         if self.pipeline_depth < 1:
             errs.append("pipeline_depth must be >= 1")
+        if self.mesh_shards < 1:
+            errs.append("mesh_shards must be >= 1")
+        if self.crypto_tpu_min_batch < 1:
+            errs.append("crypto_tpu_min_batch must be >= 1")
         if self.pipeline_depth > 1 and self.leader_rotation:
             errs.append("pipeline_depth > 1 requires leader_rotation off")
         if self.trace.capacity < 1:

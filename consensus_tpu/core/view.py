@@ -1095,7 +1095,21 @@ class View:
             expected_seq = self.proposal_sequence
         if expected_decisions is None:
             expected_decisions = self.decisions_in_view
-        requests = self._verifier.verify_proposal(proposal)
+        # The pre-prepare carries two signature waves: the proposal's
+        # request signatures and the previous decision's commit-signature
+        # quorum.  The previous cert only applies when no reconfiguration
+        # happened in between (reference view.go:606-647 skips otherwise);
+        # routing both waves through one port call lets verifiers that
+        # share an engine fuse them into a single launch.  A request
+        # failure still raises here, before any cert result is consumed.
+        prev_proposal, _ = self._checkpoint.get()
+        expected_vseq = self._verifier.verification_sequence()
+        certs_apply = bool(prev_commits) and (
+            prev_proposal.verification_sequence == expected_vseq
+        )
+        requests, cert_results = self._verifier.verify_proposal_and_prev_commits(
+            proposal, prev_commits if certs_apply else (), prev_proposal
+        )
 
         md = decode_view_metadata(proposal.metadata)
         if md.view_id != self.number:
@@ -1108,13 +1122,16 @@ class View:
             raise ValueError(
                 f"metadata decisions-in-view {md.decisions_in_view} != {expected_decisions}"
             )
-        expected_vseq = self._verifier.verification_sequence()
         if proposal.verification_sequence != expected_vseq:
             raise ValueError(
                 f"verification sequence {proposal.verification_sequence} != {expected_vseq}"
             )
 
-        prepare_acks = self._verify_prev_commit_signatures(prev_commits, expected_vseq)
+        prepare_acks = (
+            self._decode_prev_commit_acks(prev_commits, cert_results)
+            if certs_apply
+            else {}
+        )
         self._verify_blacklist(prev_commits, expected_vseq, md, prepare_acks)
 
         # The metadata must commit to the exact previous-signature set.
@@ -1140,6 +1157,14 @@ class View:
         results = self._verifier.verify_consenter_sigs_batch(
             prev_commits, prev_proposal
         )
+        return self._decode_prev_commit_acks(prev_commits, results)
+
+    @staticmethod
+    def _decode_prev_commit_acks(
+        prev_commits: Sequence[Signature], results: Sequence[Optional[bytes]]
+    ) -> dict[int, PreparesFrom]:
+        """Turn a cert wave's verdicts into the per-signer prepare-ack map,
+        raising on the first invalid signature or malformed vouch payload."""
         acks: dict[int, PreparesFrom] = {}
         for sig, aux in zip(prev_commits, results):
             if aux is None:

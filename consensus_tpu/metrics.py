@@ -56,6 +56,26 @@ OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_VERIFY_COLLAPSE_KEY,
 )
 
+#: Pinned instrument names for the multi-tenant verification sidecar
+#: (consensus_tpu/net/sidecar.py).  Admission control (bounded per-tenant
+#: queues with structured rejects, never stalls) and cross-tenant wave
+#: forming (many tenants' signatures coalesced into one mesh launch) each
+#: get a counter; per-tenant series hang off these via ``with_labels``.
+SIDECAR_ADMISSION_ACCEPTED_KEY = "sidecar_admission_accepted"
+SIDECAR_ADMISSION_REJECTS_KEY = "sidecar_admission_rejects"
+SIDECAR_ADMISSION_QUEUE_DEPTH_KEY = "sidecar_admission_queue_depth"
+SIDECAR_WAVE_LAUNCHES_KEY = "sidecar_wave_launches"
+SIDECAR_WAVE_SIGNATURES_KEY = "sidecar_wave_signatures"
+SIDECAR_WAVE_TENANTS_KEY = "sidecar_wave_tenants"
+SIDECAR_KEYS = (
+    SIDECAR_ADMISSION_ACCEPTED_KEY,
+    SIDECAR_ADMISSION_REJECTS_KEY,
+    SIDECAR_ADMISSION_QUEUE_DEPTH_KEY,
+    SIDECAR_WAVE_LAUNCHES_KEY,
+    SIDECAR_WAVE_SIGNATURES_KEY,
+    SIDECAR_WAVE_TENANTS_KEY,
+)
+
 #: THE module-level registry of every pinned instrument name: key -> one-line
 #: description.  Tests and embedder dashboards key on this mapping; every
 #: name here is created by a fresh ``Metrics`` bundle (asserted by
@@ -81,6 +101,18 @@ PINNED_METRIC_KEYS: dict[str, str] = {
         "detector firings: ledger height diverging from the running peers",
     OBS_ANOMALY_VERIFY_COLLAPSE_KEY:
         "detector firings: ledger growth with zero verify launches",
+    SIDECAR_ADMISSION_ACCEPTED_KEY:
+        "sidecar verification batches admitted to a tenant queue",
+    SIDECAR_ADMISSION_REJECTS_KEY:
+        "sidecar batches rejected at admission (tenant queue full)",
+    SIDECAR_ADMISSION_QUEUE_DEPTH_KEY:
+        "signatures queued across tenant queues at last admission (gauge)",
+    SIDECAR_WAVE_LAUNCHES_KEY:
+        "cross-tenant waves launched on the sidecar engine",
+    SIDECAR_WAVE_SIGNATURES_KEY:
+        "signatures verified across all sidecar waves",
+    SIDECAR_WAVE_TENANTS_KEY:
+        "tenants sharing a wave, summed over waves (launches divides it)",
 }
 
 
@@ -502,6 +534,47 @@ class MetricsObs(_Bundle):
         return getattr(self, f"count_anomaly_{kind}")
 
 
+class MetricsSidecar(_Bundle):
+    """Multi-tenant verification-sidecar instruments — consensus_tpu
+    addition, fed by ``net.sidecar.VerifySidecarServer``.  Per-tenant series
+    are children of these pinned names (``with_labels(tenant)`` ->
+    ``name{tenant}`` in the in-memory provider), so the aggregate names stay
+    stable for dashboards while isolation tests can read one tenant out."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_admission_accepted = p.new_counter(
+            SIDECAR_ADMISSION_ACCEPTED_KEY,
+            "Verification batches admitted to a tenant queue.",
+            ln,
+        )
+        self.count_admission_rejects = p.new_counter(
+            SIDECAR_ADMISSION_REJECTS_KEY,
+            "Batches rejected at admission because the tenant queue was full.",
+            ln,
+        )
+        self.admission_queue_depth = p.new_gauge(
+            SIDECAR_ADMISSION_QUEUE_DEPTH_KEY,
+            "Signatures queued across tenant queues at the last admission.",
+            ln,
+        )
+        self.count_wave_launches = p.new_counter(
+            SIDECAR_WAVE_LAUNCHES_KEY,
+            "Cross-tenant waves launched on the sidecar engine.",
+            ln,
+        )
+        self.count_wave_signatures = p.new_counter(
+            SIDECAR_WAVE_SIGNATURES_KEY,
+            "Signatures verified across all sidecar waves.",
+            ln,
+        )
+        self.count_wave_tenants = p.new_counter(
+            SIDECAR_WAVE_TENANTS_KEY,
+            "Tenants sharing a wave, summed over waves.",
+            ln,
+        )
+
+
 class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
@@ -539,6 +612,7 @@ class Metrics:
         self.sync = MetricsSync(provider, label_names)
         self.network = MetricsNetwork(provider, label_names)
         self.obs = MetricsObs(provider, label_names)
+        self.sidecar = MetricsSidecar(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
         """Bind embedder label values on every bundle (e.g. the channel id).
@@ -571,6 +645,7 @@ __all__ = [
     "MetricsSync",
     "MetricsNetwork",
     "MetricsObs",
+    "MetricsSidecar",
     "extend_label_names",
     "VERIFY_LAUNCH_BATCH_KEY",
     "WAL_RECORDS_PER_FSYNC_KEY",
@@ -586,5 +661,12 @@ __all__ = [
     "OBS_ANOMALY_SYNC_LAG_KEY",
     "OBS_ANOMALY_VERIFY_COLLAPSE_KEY",
     "OBS_ANOMALY_KEYS",
+    "SIDECAR_ADMISSION_ACCEPTED_KEY",
+    "SIDECAR_ADMISSION_REJECTS_KEY",
+    "SIDECAR_ADMISSION_QUEUE_DEPTH_KEY",
+    "SIDECAR_WAVE_LAUNCHES_KEY",
+    "SIDECAR_WAVE_SIGNATURES_KEY",
+    "SIDECAR_WAVE_TENANTS_KEY",
+    "SIDECAR_KEYS",
     "PINNED_METRIC_KEYS",
 ]

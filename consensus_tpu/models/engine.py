@@ -414,4 +414,216 @@ class ThreadCoalescingVerifier:
                 item.done.set()
 
 
-__all__ = ["BatchCoalescer", "ThreadCoalescingVerifier"]
+class AdmissionReject(Exception):
+    """A tenant's bounded queue is full: the submission is REJECTED with
+    structure (who, how deep, the limit) instead of stalling — the caller
+    retries or falls back locally, and other tenants' waves are untouched."""
+
+    def __init__(self, tenant: str, queue_depth: int, limit: int) -> None:
+        super().__init__(
+            f"tenant {tenant!r} admission rejected: "
+            f"{queue_depth} signatures queued, limit {limit}"
+        )
+        self.tenant = tenant
+        self.queue_depth = queue_depth
+        self.limit = limit
+
+
+class _TenantPending(_Pending):
+    __slots__ = ("tenant",)
+
+    def __init__(self, tenant, messages, signatures, keys):
+        super().__init__(messages, signatures, keys)
+        self.tenant = tenant
+
+
+class FairShareWaveFormer:
+    """Multi-tenant wave forming over one engine: per-tenant bounded queues,
+    round-robin draining, cross-tenant coalescing into single launches.
+
+    The sidecar's single-tenant coalescer (:class:`ThreadCoalescingVerifier`)
+    merges submissions but knows nothing about who they belong to — one
+    flooding client can fill every launch and starve the rest.  This former
+    gives each tenant its own queue with three properties:
+
+    * **Admission control** — a submission that would push the tenant's
+      queued signature count past ``tenant_queue_limit`` raises
+      :class:`AdmissionReject` immediately (bounded memory, structured
+      reject, never a stall).  Other tenants are unaffected: their queues,
+      their limits.
+    * **Fair share** — waves are formed round-robin across tenant queues,
+      one whole submission per tenant per pass, and the rotation order
+      advances every wave, so a heavy tenant gets the leftover capacity
+      but can never exclude a light one from the next launch.
+    * **Deadline-aware coalescing** — a wave closes when ``max_wave``
+      signatures are aboard or ``window`` seconds after the first pending
+      submission, whichever is first; until then, cross-tenant submissions
+      keep joining the same launch.
+
+    ``on_wave(tenant_counts, total)`` fires after each successful launch
+    with the per-tenant signature counts that rode it — the sidecar's
+    metrics/kernel-accounting hook.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        window: float = 0.005,
+        max_wave: int = 8192,
+        tenant_queue_limit: int = 4096,
+        on_wave: Optional[Callable[[dict, int], None]] = None,
+        wait_timeout: float = 300.0,
+        name: str = "verify-waves",
+    ) -> None:
+        self._engine = engine
+        self._window = window
+        self._max_wave = max(1, max_wave)
+        self._tenant_queue_limit = max(1, tenant_queue_limit)
+        self._on_wave = on_wave
+        self._wait_timeout = wait_timeout
+        self._cv = threading.Condition()
+        self._queues: dict[str, list[_TenantPending]] = {}
+        self._rr: list[str] = []
+        self._count = 0
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
+        self._thread.start()
+
+    def queue_depth(self, tenant: str) -> int:
+        """Signatures currently queued for ``tenant``."""
+        with self._cv:
+            return sum(len(i.messages) for i in self._queues.get(tenant, ()))
+
+    @property
+    def pending_count(self) -> int:
+        return self._count
+
+    def submit(self, tenant: str, messages, signatures, public_keys) -> np.ndarray:
+        """Queue one tenant submission and block until its wave lands.
+        Raises :class:`AdmissionReject` when the tenant's queue is full."""
+        n = len(messages)
+        if not (n == len(signatures) == len(public_keys)):
+            raise ValueError("batch length mismatch")
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("wave former is closed")
+            depth = sum(len(i.messages) for i in self._queues.get(tenant, ()))
+            if depth + n > self._tenant_queue_limit:
+                raise AdmissionReject(tenant, depth, self._tenant_queue_limit)
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = []
+                self._rr.append(tenant)
+            item = _TenantPending(
+                tenant, list(messages), list(signatures), list(public_keys)
+            )
+            q.append(item)
+            self._count += n
+            self._cv.notify_all()
+        if not item.done.wait(timeout=self._wait_timeout):
+            raise RuntimeError(
+                f"verify wave did not complete within {self._wait_timeout}s "
+                "(wedged device?)"
+            )
+        if item.error is not None:
+            raise RuntimeError(
+                f"coalesced verify wave failed: {item.error!r}"
+            ) from item.error
+        return item.result
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=self._wait_timeout)
+        if self._thread.is_alive():
+            logger.error(
+                "wave former thread did not exit within %.1fs (wedged device?)",
+                self._wait_timeout,
+            )
+
+    # -- wave thread -------------------------------------------------------
+
+    def _take_wave(self) -> list[_TenantPending]:
+        """Pop whole submissions round-robin across tenant queues up to
+        ``max_wave`` signatures, then advance the rotation so the next wave
+        starts with a different tenant."""
+        taken: list[_TenantPending] = []
+        total = 0
+        progress = True
+        while progress and total < self._max_wave:
+            progress = False
+            for tenant in self._rr:
+                q = self._queues.get(tenant)
+                if not q:
+                    continue
+                nxt = len(q[0].messages)
+                if taken and total + nxt > self._max_wave:
+                    continue
+                taken.append(q.pop(0))
+                total += nxt
+                progress = True
+        if self._rr:
+            self._rr.append(self._rr.pop(0))
+        self._count -= total
+        return taken
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._count and not self._closed:
+                    self._cv.wait()
+                if not self._count and self._closed:
+                    return
+                # Real-thread deadline: wave closes at first-pending + window
+                # or the size cap, whichever fires first.
+                deadline = time.monotonic() + self._window  # wallclock-ok
+                while self._count < self._max_wave and not self._closed:
+                    remaining = deadline - time.monotonic()  # wallclock-ok
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
+                wave = self._take_wave()
+            if not wave:
+                continue
+            messages: list = []
+            signatures: list = []
+            keys: list = []
+            for item in wave:
+                messages.extend(item.messages)
+                signatures.extend(item.signatures)
+                keys.extend(item.keys)
+            try:
+                results = np.asarray(
+                    self._engine.verify_batch(messages, signatures, keys)
+                )
+                slices = _split_results(results, [len(i.messages) for i in wave])
+            except BaseException as exc:
+                for item in wave:
+                    item.error = exc
+                    item.done.set()
+                continue
+            if self._on_wave is not None:
+                tenant_counts: dict[str, int] = {}
+                for item in wave:
+                    tenant_counts[item.tenant] = (
+                        tenant_counts.get(item.tenant, 0) + len(item.messages)
+                    )
+                try:
+                    self._on_wave(tenant_counts, len(messages))
+                except Exception:
+                    logger.exception("on_wave hook failed (ignored)")
+            for item, piece in zip(wave, slices):
+                item.result = piece
+                item.done.set()
+
+
+__all__ = [
+    "AdmissionReject",
+    "BatchCoalescer",
+    "FairShareWaveFormer",
+    "ThreadCoalescingVerifier",
+]

@@ -46,20 +46,50 @@ def raw_message(data: bytes) -> bytes:
     return _RAW_TAG + data
 
 
-def engine_for_config(config) -> Ed25519BatchVerifier:
-    """The Ed25519 batch engine matching a ``Configuration``'s crypto knobs
-    (``batch_verify_mode``, ``crypto_pad_pow2``, ``crypto_tpu_min_batch``).
-    Every replica in a cluster must build its engine from the same config —
-    verdict parity across replicas is a quorum-safety requirement."""
-    cls = (
-        Ed25519RandomizedBatchVerifier
-        if getattr(config, "batch_verify_mode", False)
-        else Ed25519BatchVerifier
-    )
-    return cls(
+def engine_for_config(config, curve: str = "ed25519"):
+    """The batch engine matching a ``Configuration``'s crypto knobs
+    (``batch_verify_mode``, ``crypto_pad_pow2``, ``crypto_tpu_min_batch``,
+    ``mesh_shards``).  ``mesh_shards > 1`` selects the sharded engines from
+    :mod:`consensus_tpu.parallel` over a mesh of that many devices;
+    ``mesh_shards = 1`` returns today's single-device engines bit-for-bit.
+    Every replica in a cluster must agree on the VERDICT-affecting knobs
+    (``batch_verify_mode``, the curve) — verdict parity across replicas is
+    a quorum-safety requirement; ``mesh_shards`` only changes the launch
+    topology and may differ per replica."""
+    randomized = bool(getattr(config, "batch_verify_mode", False))
+    shards = int(getattr(config, "mesh_shards", 1) or 1)
+    kw = dict(
         pad_pow2=config.crypto_pad_pow2,
         min_device_batch=config.crypto_tpu_min_batch,
     )
+    if curve == "p256":
+        if randomized:
+            raise ValueError(
+                "batch_verify_mode is Ed25519-only (no randomized P-256 lane)"
+            )
+        from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
+
+        if shards > 1:
+            from consensus_tpu.parallel import (
+                ShardedEcdsaP256Verifier,
+                mesh_for_shards,
+            )
+
+            return ShardedEcdsaP256Verifier(mesh_for_shards(shards), **kw)
+        return EcdsaP256BatchVerifier(**kw)
+    if curve != "ed25519":
+        raise ValueError(f"unknown curve {curve!r}")
+    if shards > 1:
+        from consensus_tpu.parallel import (
+            ShardedEd25519RandomizedVerifier,
+            ShardedEd25519Verifier,
+            mesh_for_shards,
+        )
+
+        cls = ShardedEd25519RandomizedVerifier if randomized else ShardedEd25519Verifier
+        return cls(mesh_for_shards(shards), **kw)
+    cls = Ed25519RandomizedBatchVerifier if randomized else Ed25519BatchVerifier
+    return cls(**kw)
 
 
 class Ed25519Signer(Signer):
@@ -151,6 +181,31 @@ class Ed25519VerifierMixin(Verifier):
         """Swap the key registry (reconfiguration)."""
         self._public_keys = dict(public_keys)
 
+    @property
+    def engine(self):
+        """The batch engine behind this verifier — lets applications fuse
+        their own signature waves (e.g. client requests) into the same
+        launch, provided they use THIS engine (SAFETY.md §7: never mix
+        engines within one quorum cert's worth of verdicts)."""
+        return self._engine
+
+    def consenter_sig_triples(
+        self, signatures: Sequence[Signature], proposal: Proposal
+    ) -> tuple[list[bytes], list[bytes], list[bytes], list[bool]]:
+        """The (messages, sigs, keys, known) arrays that
+        :meth:`verify_consenter_sigs_batch` would launch — exposed so a
+        caller can append them to a larger wave and run ONE engine call
+        covering requests + consenter certs."""
+        messages, sigs, keys = [], [], []
+        known: list[bool] = []
+        for sig in signatures:
+            key = self._public_keys.get(sig.id)
+            known.append(key is not None)
+            messages.append(commit_message(proposal, sig.msg))
+            sigs.append(sig.value)
+            keys.append(key if key is not None else b"")
+        return messages, sigs, keys, known
+
     # --- single-signature paths (host) ----------------------------------
 
     def verify_consenter_sig(self, signature: Signature, proposal: Proposal) -> bytes:
@@ -174,14 +229,9 @@ class Ed25519VerifierMixin(Verifier):
     def verify_consenter_sigs_batch(
         self, signatures: Sequence[Signature], proposal: Proposal
     ) -> list[Optional[bytes]]:
-        messages, sigs, keys = [], [], []
-        known: list[bool] = []
-        for sig in signatures:
-            key = self._public_keys.get(sig.id)
-            known.append(key is not None)
-            messages.append(commit_message(proposal, sig.msg))
-            sigs.append(sig.value)
-            keys.append(key if key is not None else b"")
+        messages, sigs, keys, known = self.consenter_sig_triples(
+            signatures, proposal
+        )
         ok = self._engine.verify_batch(messages, sigs, keys)
         return [
             signatures[i].msg if (known[i] and ok[i]) else None

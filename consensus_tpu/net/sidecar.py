@@ -1,38 +1,63 @@
-"""Verification sidecar: n replica *processes* sharing one TPU.
+"""Verification service: many replica *processes* — and many replica
+CLUSTERS — sharing one device mesh.
 
 The reference always deploys replicas as separate OS processes (its Comm
 contract is a network transport, reference pkg/api/dependencies.go:22-30);
 each Go process burns its own cores verifying signatures.  The TPU-native
-deployment shape (SURVEY §7 step 9) keeps one device per host and lets all
-co-located replica processes drain their signature sweeps into it through a
-tiny socket front: the sidecar process owns the engine (and the one
-compiled kernel shape) and coalesces concurrent requests from any number of
-replica processes into single device launches via
-:class:`consensus_tpu.models.engine.ThreadCoalescingVerifier`.
+deployment shape (SURVEY §7 step 9) keeps one device (or mesh) per host and
+lets every co-located replica process drain its signature sweeps into it
+through a tiny socket front: the sidecar process owns the engine (and the
+one compiled kernel shape) and coalesces concurrent requests into single
+device launches.
+
+**Single-tenant mode** (no ``tenants`` map): exactly the PR-4 behavior —
+one shared secret, requests served straight on the engine (typically a
+:class:`consensus_tpu.models.engine.ThreadCoalescingVerifier`).
+
+**Multi-tenant mode** (``tenants`` = tenant id -> secret): one server
+serves many replica clusters/channels.  Each connection authenticates AS a
+tenant (per-tenant secret, same wire format as the legacy handshake), and
+requests flow through a :class:`consensus_tpu.models.engine
+.FairShareWaveFormer`: per-tenant bounded queues with admission control
+(structured reject — status 2 — never a stall), round-robin fair-share
+draining, and deadline-aware cross-tenant coalescing so four channels'
+quorum certs ride ONE mesh launch.  Per-tenant metrics land in a
+:class:`consensus_tpu.metrics.MetricsSidecar` bundle and per-tenant kernel
+attribution in :data:`consensus_tpu.obs.kernels.TENANT_KERNELS`.
 
 Client side, :class:`SidecarVerifierClient` is a drop-in ``engine`` for the
 ``Verifier`` mixins (same ``verify_batch`` contract).  With a
 ``local_engine`` supplied it also inherits the wedged-device escape hatch:
 a sidecar that dies or stalls past ``request_timeout`` fails over to local
 host verification (slower, still correct) instead of wedging the replica.
+An admission reject surfaces as :class:`TenantAdmissionReject` (structured:
+tenant, queue depth, limit) and falls back locally WITHOUT marking the
+sidecar suspect — the service is healthy, the tenant is over quota.
 
 Framing (both directions, all integers big-endian):
 
     u32 payload_len | u64 req_id | payload
 
 Request payload:  u32 count | count * (u32 mlen u32 slen u32 klen m s k)
-Response payload: u8 status (0=ok, 1=error) | count result bytes / utf-8 error
+Response payload: u8 status | body
+    status 0: count result bytes
+    status 1: utf-8 error text
+    status 2: u32 queue_depth | u32 limit | utf-8 tenant  (admission reject)
 
 Addresses: a ``(host, port)`` tuple serves TCP (cross-container), a string
 serves a unix domain socket (same-host, lower latency — the common shape).
-TCP mode REQUIRES ``auth_secret`` (a shared secret): the handshake is
-MUTUAL (both ends prove knowledge of the secret over a domain-separated
-nonce pair) and derives a per-connection session key that MACs every frame
-in both directions — a verification verdict is consensus input, so a peer
-in path must not be able to forge "all valid" responses (it can still drop
-the connection; that is the failover path, not a safety hole).  Unix
-sockets rely on filesystem permissions instead but honour the secret when
-given.
+TCP mode REQUIRES authentication (``auth_secret`` and/or ``tenants``): the
+handshake is MUTUAL (both ends prove knowledge of the secret over a
+domain-separated nonce pair) and derives a per-connection session key that
+MACs every frame in both directions — a verification verdict is consensus
+input, so a peer in path must not be able to forge "all valid" responses
+(it can still drop the connection; that is the failover path, not a safety
+hole).  Unix sockets rely on filesystem permissions instead but honour the
+secrets when given.  The tenant handshake is wire-compatible with the
+legacy one (same byte counts in each direction); the server distinguishes
+tenants by WHICH secret validates the proof, with the tenant id bound into
+the proof/session-key derivations so two tenants sharing a secret value
+still get distinct sessions.
 """
 
 from __future__ import annotations
@@ -48,6 +73,10 @@ import time
 from typing import Optional, Sequence, Union
 
 import numpy as np
+
+# jax-free (models/engine.py is pure numpy/threading), so importing the
+# sidecar module still never drags in the accelerator stack.
+from consensus_tpu.models.engine import AdmissionReject as _AdmissionReject
 
 logger = logging.getLogger("consensus_tpu.net.sidecar")
 
@@ -66,6 +95,10 @@ _HANDSHAKE_TIMEOUT = 5.0
 _CLIENT_PROOF = b"ctpu-sidecar-client-v1"
 _SERVER_PROOF = b"ctpu-sidecar-server-v1"
 _SESSION_KEY = b"ctpu-sidecar-session-v1"
+#: Tenant-mode client proof: a distinct domain tag (and the tenant id bound
+#: into every derivation) so a legacy transcript can never double as a
+#: tenant proof or vice versa.
+_TENANT_PROOF = b"ctpu-sidecar-tenant-v1"
 
 Address = Union[tuple, str]
 
@@ -74,6 +107,49 @@ class QueueStallTimeout(TimeoutError):
     """The per-request budget expired while the request was still QUEUED
     behind other senders — the wire itself was never observed to stall, so
     callers must not treat this as evidence the sidecar is wedged."""
+
+
+class SidecarQueueStall(QueueStallTimeout):
+    """A :class:`QueueStallTimeout` with structure: WHICH tenant gave up,
+    how many requests were locally queued ahead of it, and the budget that
+    expired — so a multi-tenant operator can tell one tenant's local send
+    pressure from a service-wide stall."""
+
+    def __init__(
+        self, reason: str, *, tenant: str = "", queue_depth: int = 0,
+        deadline: float = 0.0,
+    ) -> None:
+        super().__init__(reason)
+        self.tenant = tenant
+        self.queue_depth = queue_depth
+        self.deadline = deadline
+
+
+class TenantAdmissionReject(RuntimeError):
+    """The server REJECTED the batch at admission (tenant queue full,
+    status 2) — structured, immediate, and deliberately NOT a
+    ``TimeoutError``: the service is healthy, so the client must fall back
+    locally without marking the sidecar suspect or disturbing other
+    tenants' waves."""
+
+    def __init__(self, tenant: str, queue_depth: int, limit: int) -> None:
+        super().__init__(
+            f"sidecar admission rejected tenant {tenant!r}: "
+            f"{queue_depth} signatures queued, limit {limit}"
+        )
+        self.tenant = tenant
+        self.queue_depth = queue_depth
+        self.limit = limit
+
+
+def _with_tenant(instrument, tenant: str):
+    """The per-tenant child series of a pinned instrument, or the base
+    instrument when the bundle was built without a tenant label (metrics
+    must never break the serve path)."""
+    try:
+        return instrument.with_labels(tenant)
+    except Exception:
+        return instrument
 
 
 def _hmac256(key: bytes, *parts: bytes) -> bytes:
@@ -209,18 +285,58 @@ class VerifySidecarServer:
         engine,
         *,
         auth_secret: Optional[bytes] = None,
+        tenants: Optional[dict] = None,
         max_inflight: int = 32,
         max_frame: int = _MAX_FRAME,
         io_timeout: float = 60.0,
+        wave_window: float = 0.005,
+        max_wave: int = 8192,
+        tenant_queue_limit: int = 4096,
+        metrics=None,
+        tenant_accounting=None,
     ) -> None:
         self._address = address
         self._engine = engine
         self._secret = auth_secret
+        self._tenants = dict(tenants) if tenants else None
         self._max_inflight = max_inflight
         self._max_frame = max_frame
         self._io_timeout = io_timeout
+        self._metrics = metrics
+        self._accounting = tenant_accounting
+        self._former = None
+        if self._tenants is not None:
+            from consensus_tpu.models.engine import FairShareWaveFormer
+
+            if self._accounting is None:
+                from consensus_tpu.obs.kernels import TENANT_KERNELS
+
+                self._accounting = TENANT_KERNELS
+            self._former = FairShareWaveFormer(
+                engine,
+                window=wave_window,
+                max_wave=max_wave,
+                tenant_queue_limit=tenant_queue_limit,
+                on_wave=self._record_wave,
+                name="sidecar-waves",
+            )
         self._listener: Optional[socket.socket] = None
         self._stopping = False
+
+    def _record_wave(self, tenant_counts: dict, total: int) -> None:
+        """FairShareWaveFormer hook: per-tenant kernel attribution + the
+        pinned wave metrics (one launch, its signature volume, how many
+        tenants shared it)."""
+        if self._accounting is not None:
+            for tenant, count in tenant_counts.items():
+                self._accounting.record_wave(tenant, count)
+        m = self._metrics
+        if m is not None:
+            m.count_wave_launches.add(1)
+            m.count_wave_signatures.add(total)
+            m.count_wave_tenants.add(len(tenant_counts))
+            for tenant, count in tenant_counts.items():
+                _with_tenant(m.count_wave_signatures, tenant).add(count)
 
     @property
     def address(self) -> Address:
@@ -235,12 +351,12 @@ class VerifySidecarServer:
                 pass
             listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             listener.bind(self._address)
-        elif self._secret is None:
+        elif self._secret is None and self._tenants is None:
             raise ValueError(
-                "TCP sidecar mode requires auth_secret: an unauthenticated "
-                "TCP listener hands free verification cycles to anyone who "
-                "can reach the port (use a unix socket for same-host "
-                "deployments)"
+                "TCP sidecar mode requires auth_secret or tenants: an "
+                "unauthenticated TCP listener hands free verification "
+                "cycles to anyone who can reach the port (use a unix "
+                "socket for same-host deployments)"
             )
         else:
             listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -260,6 +376,8 @@ class VerifySidecarServer:
                 self._listener.close()
             except OSError:
                 pass
+        if self._former is not None:
+            self._former.close()
         if isinstance(self._address, str):
             try:
                 os.unlink(self._address)
@@ -282,28 +400,66 @@ class VerifySidecarServer:
                 name="sidecar-conn",
             ).start()
 
-    def _handshake(self, conn: socket.socket) -> Optional[bytes]:
-        """MUTUAL challenge-response: the peer proves knowledge of the
-        secret over (server_nonce, client_nonce), the server proves it back,
-        and both derive the per-connection session key that MACs every
-        frame.  Returns the session key, or None to drop the peer.  Runs
-        under a deadline so an idle connect cannot park a thread."""
+    def _handshake(self, conn: socket.socket) -> Optional[tuple[bytes, str]]:
+        """MUTUAL challenge-response: the peer proves knowledge of A secret
+        over (server_nonce, client_nonce), the server proves it back, and
+        both derive the per-connection session key that MACs every frame.
+        Returns ``(session_key, tenant_id)`` — tenant ``""`` for the legacy
+        shared secret — or None to drop the peer.  The tenant variant is
+        byte-compatible on the wire: the server identifies the tenant by
+        WHICH secret validates the proof (the tenant id is bound inside the
+        HMACs, not sent in clear).  Runs under a deadline so an idle
+        connect cannot park a thread."""
         conn.settimeout(_HANDSHAKE_TIMEOUT)
         try:
             server_nonce = os.urandom(_NONCE_LEN)
             conn.sendall(server_nonce)
             client_nonce = _recv_exact(conn, _NONCE_LEN)
             answer = _recv_exact(conn, hashlib.sha256().digest_size)
-            expect = _hmac256(
-                self._secret, _CLIENT_PROOF, server_nonce, client_nonce
-            )
-            if not hmac.compare_digest(answer, expect):
+            matched: Optional[tuple[bytes, str, bytes, bytes]] = None
+            if self._secret is not None:
+                expect = _hmac256(
+                    self._secret, _CLIENT_PROOF, server_nonce, client_nonce
+                )
+                if hmac.compare_digest(answer, expect):
+                    matched = (
+                        self._secret,
+                        "",
+                        _hmac256(
+                            self._secret, _SERVER_PROOF,
+                            server_nonce, client_nonce,
+                        ),
+                        _hmac256(
+                            self._secret, _SESSION_KEY,
+                            server_nonce, client_nonce,
+                        ),
+                    )
+            if matched is None and self._tenants:
+                for tenant, secret in self._tenants.items():
+                    tid = tenant.encode()
+                    expect = _hmac256(
+                        secret, _TENANT_PROOF, tid, server_nonce, client_nonce
+                    )
+                    if hmac.compare_digest(answer, expect):
+                        matched = (
+                            secret,
+                            tenant,
+                            _hmac256(
+                                secret, _SERVER_PROOF, tid,
+                                server_nonce, client_nonce,
+                            ),
+                            _hmac256(
+                                secret, _SESSION_KEY, tid,
+                                server_nonce, client_nonce,
+                            ),
+                        )
+                        break
+            if matched is None:
                 logger.warning("sidecar: rejected peer with bad auth answer")
                 return None
-            conn.sendall(
-                _hmac256(self._secret, _SERVER_PROOF, server_nonce, client_nonce)
-            )
-            return _hmac256(self._secret, _SESSION_KEY, server_nonce, client_nonce)
+            _, tenant, server_proof, session_key = matched
+            conn.sendall(server_proof)
+            return session_key, tenant
         except (ConnectionError, OSError):
             logger.warning("sidecar: peer failed to complete auth handshake")
             return None
@@ -315,11 +471,13 @@ class VerifySidecarServer:
         # backpressure) instead of growing the thread count.
         slots = threading.BoundedSemaphore(self._max_inflight)
         mac_key: Optional[bytes] = None
+        tenant = ""
         try:
-            if self._secret is not None:
-                mac_key = self._handshake(conn)
-                if mac_key is None:
+            if self._secret is not None or self._tenants is not None:
+                outcome = self._handshake(conn)
+                if outcome is None:
                     return
+                mac_key, tenant = outcome
             # Socket timeout bounds worker SENDS to a non-reading peer; the
             # read loop below treats frame-boundary timeouts as idle.
             conn.settimeout(self._io_timeout)
@@ -333,7 +491,10 @@ class VerifySidecarServer:
                 slots.acquire()
                 threading.Thread(
                     target=self._serve_request,
-                    args=(conn, write_lock, slots, mac_key, req_id, payload),
+                    args=(
+                        conn, write_lock, slots, mac_key, tenant,
+                        req_id, payload,
+                    ),
                     daemon=True,
                     name="sidecar-verify",
                 ).start()
@@ -345,15 +506,46 @@ class VerifySidecarServer:
             except OSError:
                 pass
 
+    def _verify(self, tenant: str, messages, signatures, keys):
+        """Single-tenant mode serves straight on the engine (PR-4 path);
+        multi-tenant mode goes through the fair-share wave former, which may
+        raise :class:`consensus_tpu.models.engine.AdmissionReject`."""
+        if self._former is None:
+            return self._engine.verify_batch(messages, signatures, keys)
+        results = self._former.submit(tenant, messages, signatures, keys)
+        m = self._metrics
+        if m is not None:
+            m.count_admission_accepted.add(1)
+            _with_tenant(m.count_admission_accepted, tenant).add(1)
+            m.admission_queue_depth.set(self._former.pending_count)
+        return results
+
     def _serve_request(
-        self, conn, write_lock, slots, mac_key, req_id: int, payload: bytes
+        self, conn, write_lock, slots, mac_key, tenant: str, req_id: int,
+        payload: bytes,
     ) -> None:
         try:
             messages, signatures, keys = decode_request(payload)
-            results = np.asarray(self._engine.verify_batch(messages, signatures, keys))
+            results = np.asarray(self._verify(tenant, messages, signatures, keys))
             if len(results) != len(messages):
                 raise ValueError("engine returned wrong result count")
             body = b"\x00" + np.asarray(results, dtype=np.uint8).tobytes()
+        except _AdmissionReject as rej:
+            # Structured, immediate, and NOT an error to log at exception
+            # level: the tenant is over quota, the service is fine.
+            logger.warning(
+                "sidecar admission reject: tenant %r depth %d limit %d",
+                tenant, rej.queue_depth, rej.limit,
+            )
+            body = (
+                b"\x02"
+                + struct.pack(">II", rej.queue_depth, rej.limit)
+                + tenant.encode()
+            )
+            m = self._metrics
+            if m is not None:
+                m.count_admission_rejects.add(1)
+                _with_tenant(m.count_admission_rejects, tenant).add(1)
         except Exception as exc:  # serve the error, keep the connection
             logger.exception("sidecar verify request %d failed", req_id)
             body = b"\x01" + repr(exc).encode()
@@ -398,6 +590,11 @@ class SidecarVerifierClient:
 
     ``auth_secret``: shared secret answering the server's TCP
     challenge-response handshake (must match the server's).
+
+    ``tenant``: authenticate as this tenant on a multi-tenant server —
+    ``auth_secret`` then holds the PER-TENANT secret and the handshake
+    binds the tenant id into every derivation.  Leave None for the legacy
+    single-tenant handshake.
     """
 
     def __init__(
@@ -410,6 +607,7 @@ class SidecarVerifierClient:
         bypass_below: int = 0,
         probe_interval: float = 10.0,
         auth_secret: Optional[bytes] = None,
+        tenant: Optional[str] = None,
         fault_plan=None,
         tracer=None,
     ) -> None:
@@ -426,6 +624,9 @@ class SidecarVerifierClient:
         self._bypass_below = bypass_below if local_engine is not None else 0
         self._probe_interval = probe_interval
         self._secret = auth_secret
+        self._tenant = tenant
+        if tenant is not None and auth_secret is None:
+            raise ValueError("tenant mode requires auth_secret (the tenant secret)")
         self._mac_key: Optional[bytes] = None  # per-connection session key
         self._lock = threading.Lock()  # guards socket create + pending map
         self._sock: Optional[socket.socket] = None
@@ -553,19 +754,31 @@ class SidecarVerifierClient:
         )
         self._mac_key = None
         if self._secret is not None:
+            # Legacy and tenant handshakes are byte-identical on the wire;
+            # tenant mode swaps the proof domain tag and binds the tenant id
+            # into every derivation.
+            tid = None if self._tenant is None else self._tenant.encode()
             try:
                 server_nonce = _recv_exact(sock, _NONCE_LEN)
                 client_nonce = os.urandom(_NONCE_LEN)
-                sock.sendall(
-                    client_nonce
-                    + _hmac256(
+                if tid is None:
+                    answer = _hmac256(
                         self._secret, _CLIENT_PROOF, server_nonce, client_nonce
                     )
-                )
+                    expect = _hmac256(
+                        self._secret, _SERVER_PROOF, server_nonce, client_nonce
+                    )
+                else:
+                    answer = _hmac256(
+                        self._secret, _TENANT_PROOF, tid,
+                        server_nonce, client_nonce,
+                    )
+                    expect = _hmac256(
+                        self._secret, _SERVER_PROOF, tid,
+                        server_nonce, client_nonce,
+                    )
+                sock.sendall(client_nonce + answer)
                 proof = _recv_exact(sock, hashlib.sha256().digest_size)
-                expect = _hmac256(
-                    self._secret, _SERVER_PROOF, server_nonce, client_nonce
-                )
                 if not hmac.compare_digest(proof, expect):
                     raise ConnectionError(
                         "sidecar failed mutual auth (bad server proof)"
@@ -576,9 +789,14 @@ class SidecarVerifierClient:
                 # open fd to the GC.
                 sock.close()
                 raise
-            self._mac_key = _hmac256(
-                self._secret, _SESSION_KEY, server_nonce, client_nonce
-            )
+            if tid is None:
+                self._mac_key = _hmac256(
+                    self._secret, _SESSION_KEY, server_nonce, client_nonce
+                )
+            else:
+                self._mac_key = _hmac256(
+                    self._secret, _SESSION_KEY, tid, server_nonce, client_nonce
+                )
         # A real timeout (not None) so a blocked sendall on a wedged sidecar
         # surfaces as TimeoutError instead of hanging the sender forever;
         # the reader treats frame-boundary timeouts as idle (ADVICE r4).
@@ -623,10 +841,16 @@ class SidecarVerifierClient:
             # Budget spent without touching the wire: the socket is healthy,
             # so concurrent waiters keep it — only this call bows out, and
             # the distinct type keeps verify_batch from marking the sidecar
-            # suspect over what is only local queueing pressure.
+            # suspect over what is only local queueing pressure.  Structured
+            # so a multi-tenant operator sees WHO gave up and behind how
+            # many locally queued requests.
             with self._lock:
                 self._pending.pop(req_id, None)
-            return QueueStallTimeout(reason)
+                depth = len(self._pending)
+            return SidecarQueueStall(
+                reason, tenant=self._tenant or "", queue_depth=depth,
+                deadline=budget,
+            )
 
         if not wlock.acquire(timeout=budget):
             raise _give_up_queued(f"sidecar send queue stalled for {budget}s")
@@ -665,6 +889,11 @@ class SidecarVerifierClient:
         body = waiter["body"]
         if body is None:
             raise ConnectionError("sidecar connection lost mid-request")
+        if body[0] == 2:
+            depth, limit = struct.unpack_from(">II", body, 1)
+            raise TenantAdmissionReject(
+                body[9:].decode(errors="replace"), depth, limit
+            )
         if body[0] != 0:
             raise RuntimeError(f"sidecar error: {body[1:].decode(errors='replace')}")
         results = np.frombuffer(body[1:], dtype=np.uint8).astype(bool)
@@ -730,6 +959,8 @@ __all__ = [
     "VerifySidecarServer",
     "SidecarVerifierClient",
     "QueueStallTimeout",
+    "SidecarQueueStall",
+    "TenantAdmissionReject",
     "encode_request",
     "decode_request",
 ]

@@ -22,7 +22,13 @@ from consensus_tpu.obs.flightrec import (
     FlightRecorder,
     load_flight_record,
 )
-from consensus_tpu.obs.kernels import KERNELS, KernelRegistry, instrumented_jit
+from consensus_tpu.obs.kernels import (
+    KERNELS,
+    TENANT_KERNELS,
+    KernelRegistry,
+    TenantAccounting,
+    instrumented_jit,
+)
 from consensus_tpu.obs.sampler import ClusterSampler
 
 __all__ = [
@@ -33,6 +39,8 @@ __all__ = [
     "FlightRecorder",
     "KERNELS",
     "KernelRegistry",
+    "TENANT_KERNELS",
+    "TenantAccounting",
     "instrumented_jit",
     "load_flight_record",
     "sample_to_prometheus",

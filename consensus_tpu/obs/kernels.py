@@ -88,6 +88,42 @@ class KernelRegistry:
 KERNELS = KernelRegistry()
 
 
+class TenantAccounting:
+    """Per-tenant slice of the sidecar's kernel work: which tenant's
+    signatures rode which share of the engine launches.
+
+    The multi-tenant sidecar coalesces many tenants' submissions into one
+    wave, so :data:`KERNELS` alone can no longer attribute device time to a
+    tenant; the wave former reports each launch here instead.  ``waves``
+    counts launches the tenant participated in (a shared wave counts once
+    per PARTICIPANT, so summing waves over tenants exceeds engine launches
+    exactly when coalescing is winning)."""
+
+    def __init__(self) -> None:
+        self._tenants: dict[str, dict] = {}
+
+    def record_wave(self, tenant: str, signatures: int) -> None:
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = self._tenants[tenant] = {"waves": 0, "signatures": 0}
+        t["waves"] += 1
+        t["signatures"] += signatures
+
+    def snapshot(self) -> dict:
+        """``{tenant: {waves, signatures}}``, sorted, JSON-ready."""
+        return {
+            tenant: dict(self._tenants[tenant])
+            for tenant in sorted(self._tenants)
+        }
+
+    def reset(self) -> None:
+        self._tenants.clear()
+
+
+#: Process-wide tenant accounting fed by the sidecar wave former.
+TENANT_KERNELS = TenantAccounting()
+
+
 def _cache_size(jitted) -> int:
     try:
         return int(jitted._cache_size())
@@ -137,4 +173,11 @@ def instrumented_jit(fn, name: str, *, registry: Optional[KernelRegistry] = None
     return wrapper
 
 
-__all__ = ["KERNELS", "KernelRegistry", "KernelStats", "instrumented_jit"]
+__all__ = [
+    "KERNELS",
+    "KernelRegistry",
+    "KernelStats",
+    "TENANT_KERNELS",
+    "TenantAccounting",
+    "instrumented_jit",
+]

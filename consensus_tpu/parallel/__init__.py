@@ -3,8 +3,12 @@
 from consensus_tpu.parallel.sharding import (
     BATCH_AXIS,
     ShardedEcdsaP256Verifier,
+    ShardedEd25519RandomizedVerifier,
     ShardedEd25519Verifier,
+    engine_padded_size,
     make_mesh,
+    mesh_for_shards,
+    sharded_batch_verify_fn,
     sharded_p256_verify_fn,
     sharded_verify_fn,
 )
@@ -12,8 +16,12 @@ from consensus_tpu.parallel.sharding import (
 __all__ = [
     "BATCH_AXIS",
     "make_mesh",
+    "mesh_for_shards",
+    "engine_padded_size",
     "sharded_verify_fn",
+    "sharded_batch_verify_fn",
     "sharded_p256_verify_fn",
     "ShardedEd25519Verifier",
+    "ShardedEd25519RandomizedVerifier",
     "ShardedEcdsaP256Verifier",
 ]

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
 from consensus_tpu.models.ed25519 import (
     Ed25519BatchVerifier,
+    Ed25519RandomizedBatchVerifier,
     to_kernel_layout,
     verify_impl,
 )
@@ -68,10 +69,52 @@ def mesh_padded_size(n: int, n_shards: int, minimum: int = 8) -> int:
     return size
 
 
+def engine_padded_size(
+    n: int,
+    n_shards: int,
+    *,
+    pad_to: int = 0,
+    pad_pow2: bool = True,
+    minimum: int = 8,
+) -> int:
+    """Mesh-aligned padded batch size honouring the engine's padding knobs
+    (``pad_to`` pins one compiled shape, ``pad_pow2`` grows by doubling),
+    then rounded UP to a multiple of the mesh size so every shard gets an
+    equal slice."""
+    if pad_to >= n:
+        size = pad_to
+    elif pad_pow2:
+        size = minimum
+        while size < n:
+            size *= 2
+    else:
+        size = max(n, 1)
+    size += (-size) % n_shards
+    return size
+
+
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
     """A 1-D mesh over ``devices`` (default: all visible devices)."""
     devices = list(devices if devices is not None else jax.devices())
     return Mesh(np.array(devices), (BATCH_AXIS,))
+
+
+def mesh_for_shards(n_shards: int, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over the first ``n_shards`` visible devices — the
+    ``Configuration.mesh_shards`` -> engine seam.  Fails loudly when the
+    host exposes fewer devices than the config demands: silently shrinking
+    the mesh would make the one compiled kernel shape depend on deploy-time
+    topology."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_shards < 1:
+        raise ValueError(f"mesh_shards must be >= 1, got {n_shards}")
+    if len(devices) < n_shards:
+        raise ValueError(
+            f"mesh_shards={n_shards} but only {len(devices)} device(s) "
+            "visible (set XLA_FLAGS=--xla_force_host_platform_device_count "
+            "for a host mesh, or lower mesh_shards)"
+        )
+    return Mesh(np.array(devices[:n_shards]), (BATCH_AXIS,))
 
 
 def sharded_verify_fn(mesh: Mesh):
@@ -119,7 +162,9 @@ class ShardedEd25519Verifier(Ed25519BatchVerifier):
         # the mesh-aligned size before the kernel call.
         prepped = self._prepare(messages, signatures, public_keys)
         y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok = prepped
-        padded = mesh_padded_size(n, self._n_shards)
+        padded = engine_padded_size(
+            n, self._n_shards, pad_to=self._pad_to, pad_pow2=self._pad_pow2
+        )
         if padded != n:
             pad = padded - n
             y_r = np.pad(y_r, ((0, pad), (0, 0)))
@@ -199,7 +244,9 @@ class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):
         if n < self._min_device_batch:
             return self._verify_host(messages, signatures, public_keys)
         prepped = self._prepare(messages, signatures, public_keys)
-        padded = mesh_padded_size(n, self._n_shards)
+        padded = engine_padded_size(
+            n, self._n_shards, pad_to=self._pad_to, pad_pow2=self._pad_pow2
+        )
         device_args = to_kernel_layout(*pad_prepared(prepped, padded))
         args = [
             jax.device_put(a, NamedSharding(self.mesh, spec))
@@ -209,12 +256,153 @@ class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):
         return np.asarray(ok)[:n]
 
 
+# --- randomized Ed25519 batch verification over the mesh --------------------
+
+#: Specs for the randomized-aggregate kernel (models/ed25519.py
+#: batch_verify_impl): per-lane arrays shard on the batch axis, and the
+#: fixed-base comb digits carry ONE (32, 1) column per shard — each shard
+#: checks its own aggregate [u_s]B + Σ[zkᵢ](−Aᵢ) + Σ[zᵢ](−Rᵢ) = 0 against
+#: its lanes' base-point scalar u_s.
+_RAND_IN_SPECS = (
+    P(None, BATCH_AXIS),  # y_r
+    P(BATCH_AXIS),        # sign_r
+    P(None, BATCH_AXIS),  # y_a
+    P(BATCH_AXIS),        # sign_a
+    P(None, BATCH_AXIS),  # zs_digits8: (32, n_shards), one column per shard
+    P(None, BATCH_AXIS),  # zk_digits
+    P(None, BATCH_AXIS),  # z_digits
+    P(BATCH_AXIS),        # host_ok
+)
+
+
+def sharded_batch_verify_fn(mesh: Mesh):
+    """jitted randomized-aggregate verify over ``mesh``.
+
+    Point addition is not componentwise, so the per-shard accumulators can
+    NOT be psum'd as coordinates; instead every shard runs an independent
+    aggregate check over its own lane subset (each sound to 2^-128 —
+    the conjunction is at least as strong as one whole-batch check), and
+    the single ``psum`` tree-reduces the per-shard not-identity counts
+    into the global verdict.  A padding-only shard contributes u_s = 0 and
+    all-masked digits, so its accumulator is the identity and it votes ok.
+    """
+    from consensus_tpu.models.ed25519 import batch_verify_impl
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=_RAND_IN_SPECS,
+        out_specs=(P(), P(BATCH_AXIS)),
+    )
+    def _shard(y_r, sign_r, y_a, sign_a, zs_digits8, zk_digits, z_digits, host_ok):
+        from consensus_tpu.models.ed25519 import suppress_pallas_scan
+
+        # Same rule as the strict shard: no pallas_call under shard_map.
+        with suppress_pallas_scan():
+            eq_ok, valid = batch_verify_impl(
+                y_r, sign_r, y_a, sign_a, zs_digits8, zk_digits, z_digits, host_ok
+            )
+        bad = jax.lax.psum(1 - eq_ok.astype(jnp.int32), BATCH_AXIS)
+        return bad == 0, valid
+
+    return instrumented_jit(_shard, "ed25519.sharded_batch_verify")
+
+
+class ShardedEd25519RandomizedVerifier(Ed25519RandomizedBatchVerifier):
+    """Randomized batch verifier whose aggregate check rides the mesh.
+
+    Only the device aggregate changes: the bisection driver, transcript
+    coefficients, host fallback, and strict-verifier floor are all
+    inherited, so verdict semantics (including the SAFETY.md §7 torsion
+    caveat) are exactly the single-device engine's.
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, **kw) -> None:
+        super().__init__(**kw)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._fn = sharded_batch_verify_fn(self.mesh)
+        self._n_shards = self.mesh.devices.size
+
+    def _aggregate_device(self, idx, signatures, public_keys, scalars, zs):
+        from consensus_tpu.models.ed25519 import (
+            _bits_to_comb_digits8,
+            _bytes_rows_to_bits,
+            _prep_compressed,
+            _signed_digits_int,
+            _WINDOWS,
+            _Z_WINDOWS,
+            L,
+        )
+
+        m = len(idx)
+        zk = [(z * scalars[i][1]) % L for z, i in zip(zs, idx)]
+        y_r, sign_r, _ = _prep_compressed([bytes(signatures[i])[:32] for i in idx])
+        y_a, sign_a, _ = _prep_compressed([bytes(public_keys[i]) for i in idx])
+        zk_digits = np.array(
+            [_signed_digits_int(v, _WINDOWS) for v in zk], dtype=np.int16
+        ).T
+        z_digits = np.array(
+            [_signed_digits_int(z, _Z_WINDOWS) for z in zs], dtype=np.int16
+        ).T
+        zk_digits = (zk_digits + 8).astype(np.uint8)
+        z_digits = (z_digits + 8).astype(np.uint8)
+        host_ok = np.ones(m, dtype=bool)
+
+        padded = engine_padded_size(
+            m, self._n_shards, pad_to=self._pad_to, pad_pow2=self._pad_pow2
+        )
+        if padded != m:
+            pad = padded - m
+            y_r = np.pad(y_r, ((0, pad), (0, 0)))
+            y_a = np.pad(y_a, ((0, pad), (0, 0)))
+            sign_r = np.pad(sign_r, (0, pad))
+            sign_a = np.pad(sign_a, (0, pad))
+            zk_digits = np.pad(zk_digits, ((0, 0), (0, pad)), constant_values=8)
+            z_digits = np.pad(z_digits, ((0, 0), (0, pad)), constant_values=8)
+            host_ok = np.pad(host_ok, (0, pad))
+
+        # Per-shard fixed-base scalars: lane j lives on shard j // per, so
+        # u_s sums z·s over exactly that shard's live lanes.  Pad-only
+        # shards get u_s = 0 (identity comb contribution).
+        per = padded // self._n_shards
+        u_rows = np.zeros((self._n_shards, 32), dtype=np.uint8)
+        for s in range(self._n_shards):
+            u_s = 0
+            for j in range(s * per, min((s + 1) * per, m)):
+                u_s += zs[j] * scalars[idx[j]][0]
+            u_rows[s] = np.frombuffer(
+                (u_s % L).to_bytes(32, "little"), dtype=np.uint8
+            )
+        zs_digits8 = _bits_to_comb_digits8(_bytes_rows_to_bits(u_rows))
+
+        device_args = (
+            np.ascontiguousarray(y_r.T),
+            sign_r,
+            np.ascontiguousarray(y_a.T),
+            sign_a,
+            zs_digits8,
+            zk_digits,
+            z_digits,
+            host_ok,
+        )
+        args = [
+            jax.device_put(np.asarray(a), NamedSharding(self.mesh, spec))
+            for a, spec in zip(device_args, _RAND_IN_SPECS)
+        ]
+        eq_ok, valid = self._fn(*args)
+        return bool(np.asarray(eq_ok)), list(np.asarray(valid)[:m])
+
+
 __all__ = [
     "make_mesh",
+    "mesh_for_shards",
     "sharded_verify_fn",
+    "sharded_batch_verify_fn",
     "sharded_p256_verify_fn",
     "ShardedEd25519Verifier",
+    "ShardedEd25519RandomizedVerifier",
     "ShardedEcdsaP256Verifier",
     "mesh_padded_size",
+    "engine_padded_size",
     "BATCH_AXIS",
 ]

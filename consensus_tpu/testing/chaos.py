@@ -234,6 +234,7 @@ class ChaosEngine:
         metrics=None,
         tracer=None,
         crypto: Optional[str] = None,
+        engine_factory=None,
         obs=None,
         flight_dir: Optional[str] = None,
     ) -> None:
@@ -244,15 +245,24 @@ class ChaosEngine:
         on byte-identical schedules and must produce identical ledgers.
         Crypto mode also unlocks a signature-corruption byzantine arm,
         rolled on a dedicated RNG stream so non-crypto schedules replay
-        byte-for-byte unchanged."""
+        byte-for-byte unchanged.
+
+        ``engine_factory`` (requires ``crypto``) overrides the verification
+        engine construction — a zero-arg callable returning any object with
+        the ``verify_batch`` contract.  The mesh parity gates use it to run
+        the SAME schedule through sharded engines and assert byte-identical
+        ledgers/event logs against the single-device run."""
         if crypto not in (None, "ed25519", "ed25519-batch"):
             raise ValueError(f"unknown chaos crypto mode {crypto!r}")
+        if engine_factory is not None and crypto is None:
+            raise ValueError("engine_factory requires a crypto mode")
         self.schedule = schedule
         self.config_tweaks = dict(config_tweaks or DEFAULT_TWEAKS)
         self.check_durability = check_durability
         self.metrics = metrics
         self.tracer = tracer
         self.crypto = crypto
+        self.engine_factory = engine_factory
         #: Observability plane: an ``ObsConfig`` (enabled=True) samples the
         #: cluster during the run; detector firings land in the event log
         #: as ANOMALY lines and on ``ChaosResult.anomalies``.  Sampling is
@@ -428,7 +438,9 @@ class ChaosEngine:
         )
         from consensus_tpu.testing.crypto_app import CryptoApp, SigOnlyVerifier
 
-        if self.crypto == "ed25519-batch":
+        if self.engine_factory is not None:
+            engine = self.engine_factory()
+        elif self.crypto == "ed25519-batch":
             # min_randomized=2 keeps quorum-sized batches on the randomized
             # aggregate path even at chaos scale (n=4 certs).
             engine = Ed25519RandomizedBatchVerifier(

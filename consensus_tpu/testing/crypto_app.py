@@ -188,5 +188,38 @@ class SignedRequestApp(CryptoApp):
                 raise ValueError("proposal carries an invalid request signature")
         return infos
 
+    def verify_proposal_and_prev_commits(self, proposal, prev_commits, prev_proposal):
+        """Fuse the proposal's request-signature wave and the previous
+        decision's commit cert into ONE engine launch (ROADMAP item 3a tail:
+        request waves coalesce like consenter certs).  Only when both waves
+        run on the SAME engine — mixing engines inside one wave would break
+        the SAFETY.md §7 no-mixed-engine rule — and errors keep the split
+        path's order: request failures raise before any cert verdict is
+        consumed."""
+        if getattr(self._verifier, "engine", None) is not self._engine:
+            return super().verify_proposal_and_prev_commits(
+                proposal, prev_commits, prev_proposal
+            )
+        messages, sigs, keys, infos, _ = self._collect(
+            unpack_batch(proposal.payload), tolerate_parse_errors=False
+        )
+        n_req = len(messages)
+        c_msgs, c_sigs, c_keys, known = self._verifier.consenter_sig_triples(
+            prev_commits, prev_proposal
+        )
+        messages += c_msgs
+        sigs += c_sigs
+        keys += c_keys
+        if not messages:
+            return infos, []
+        ok = self._engine.verify_batch(messages, sigs, keys)
+        if n_req and not ok[:n_req].all():
+            raise ValueError("proposal carries an invalid request signature")
+        cert_results = [
+            prev_commits[i].msg if (known[i] and ok[n_req + i]) else None
+            for i in range(len(prev_commits))
+        ]
+        return infos, cert_results
+
 
 __all__ = ["CryptoApp", "SigOnlyVerifier", "SignedRequestApp", "ClientKeyring"]

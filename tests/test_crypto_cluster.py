@@ -210,3 +210,62 @@ def test_wedged_device_cluster_completes_via_host_fallback():
     cluster.assert_ledgers_consistent()
     assert coalescer.device_suspect, "escape hatch should have tripped"
     hung.never.set()  # let the stuck flusher thread exit
+
+
+def test_fused_request_and_cert_waves_halve_launches_per_decision():
+    """Satellite of the mesh/multi-tenant PR (ROADMAP item 3a tail):
+    client-request waves coalesce with the consenter-cert sweep — when the
+    app and the verifier mixin share ONE engine, each proposal verification
+    drains request signatures AND prev-commit certs in a single
+    ``verify_batch`` launch.  Launch-histogram regression: the fused wiring
+    must launch strictly fewer (and larger) batches than split engines on
+    the identical workload, with identical ledgers."""
+    from consensus_tpu.models import Ed25519Signer
+    from consensus_tpu.testing import ClientKeyring, Cluster, SignedRequestApp
+
+    class SizedEngine(CountingEngine):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.sizes = []
+
+        def verify_batch(self, messages, signatures, public_keys):
+            self.sizes.append(len(messages))
+            return super().verify_batch(messages, signatures, public_keys)
+
+    def run(fused: bool):
+        cluster = Cluster(4, seed=77)
+        app_engine = SizedEngine(min_device_batch=10**9)
+        sig_engine = app_engine if fused else SizedEngine(min_device_batch=10**9)
+        signers = {i: Ed25519Signer(i, bytes([i + 1] * 32)) for i in cluster.nodes}
+        keys = {i: s.public_bytes for i, s in signers.items()}
+        clients = ClientKeyring(
+            [Ed25519Signer(100 + i, bytes([100 + i] * 32)) for i in range(3)]
+        )
+        for node_id, node in cluster.nodes.items():
+            node.app = SignedRequestApp(
+                node_id, cluster, signers[node_id],
+                _SigVerifier(keys, engine=sig_engine),
+                client_keys=clients.public_keys, engine=app_engine,
+            )
+        cluster.start()
+        for i in range(3):
+            for c in range(3):
+                cluster.submit_to_all(clients.make_request(c, i))
+            assert cluster.run_until_ledger(i + 1, max_time=300.0)
+        cluster.assert_ledgers_consistent()
+        ledger = [d.proposal.payload for d in cluster.nodes[1].app.ledger]
+        launches = app_engine.calls + (0 if fused else sig_engine.calls)
+        sizes = sorted(app_engine.sizes + ([] if fused else sig_engine.sizes))
+        return ledger, launches, sizes
+
+    fused_ledger, fused_launches, fused_sizes = run(fused=True)
+    split_ledger, split_launches, split_sizes = run(fused=False)
+    assert fused_ledger == split_ledger, "fusing changed what was ordered"
+    assert fused_launches < split_launches, (
+        f"fused wiring did not reduce launches: {fused_launches} vs "
+        f"{split_launches}"
+    )
+    # The histogram shifted to fewer, larger batches: the fused run's
+    # biggest wave carries requests + certs together.
+    assert max(fused_sizes) > max(split_sizes)
+    assert sum(fused_sizes) == sum(split_sizes), "fusing changed total work"

@@ -589,3 +589,322 @@ def test_idle_connection_survives_io_timeout():
         client.close()
     finally:
         server.stop()
+
+
+# -- multi-tenant verification service --------------------------------------
+
+
+TENANTS = {"alpha": b"alpha-secret", "beta": b"beta-secret",
+           "gamma": b"gamma-secret", "delta": b"delta-secret"}
+
+
+def _tenant_client(address, tenant, **kw):
+    return SidecarVerifierClient(
+        address, auth_secret=TENANTS[tenant], tenant=tenant, **kw
+    )
+
+
+def test_tenant_handshake_round_trip_and_wrong_secret_rejected():
+    """Each connection authenticates AS a tenant; a wrong per-tenant secret
+    never gets service, and the legacy shared-secret client still works on
+    a server configured with both."""
+    engine = FakeEngine()
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), engine, auth_secret=SECRET, tenants=TENANTS,
+        wave_window=0.002,
+    )
+    server.start()
+    try:
+        for tenant in ("alpha", "beta"):
+            client = _tenant_client(server.address, tenant)
+            out = client.verify_batch([b"m", b"m"], [b"good", b"bad"], [b"k"] * 2)
+            assert list(out) == [True, False]
+            client.close()
+        legacy = SidecarVerifierClient(server.address, auth_secret=SECRET)
+        assert list(legacy.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
+        legacy.close()
+
+        local = FakeEngine()
+        impostor = SidecarVerifierClient(
+            server.address, auth_secret=b"beta-secret", tenant="alpha",
+            local_engine=local, request_timeout=2.0,
+        )
+        assert list(impostor.verify_batch([b"m"], [b"good"], [b"k"])) == [True]
+        assert local.calls == [1], "impostor must be served by its fallback only"
+        impostor.close()
+    finally:
+        server.stop()
+
+
+def test_four_tenants_share_one_wave_vs_four_private_sidecars():
+    """The multi-tenant thesis (pinned metric + test): four tenants'
+    concurrent quorum-sized sweeps on ONE shared server coalesce into fewer
+    engine launches than four private sidecars serving the same load."""
+    from consensus_tpu.metrics import (
+        SIDECAR_WAVE_LAUNCHES_KEY,
+        SIDECAR_WAVE_SIGNATURES_KEY,
+        SIDECAR_WAVE_TENANTS_KEY,
+        InMemoryProvider,
+        Metrics,
+    )
+    from consensus_tpu.obs.kernels import TenantAccounting
+
+    def drive(clients):
+        """Submit one 10-signature sweep per client, concurrently."""
+        outs = {}
+
+        def worker(i, c):
+            outs[i] = c.verify_batch([b"m"] * 10, [b"good"] * 10, [b"k"] * 10)
+
+        threads = [
+            threading.Thread(target=worker, args=(i, c))
+            for i, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(outs) == len(clients)
+        for out in outs.values():
+            assert out.all()
+
+    # Shared multi-tenant server: one wave former, one engine.
+    provider = InMemoryProvider()
+    metrics = Metrics(provider, label_names=("tenant",))
+    accounting = TenantAccounting()
+    shared_engine = FakeEngine()
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), shared_engine, tenants=TENANTS,
+        wave_window=0.05, metrics=metrics.sidecar, tenant_accounting=accounting,
+    )
+    server.start()
+    clients = [_tenant_client(server.address, t) for t in sorted(TENANTS)]
+    try:
+        drive(clients)
+    finally:
+        for c in clients:
+            c.close()
+        server.stop()
+    shared_launches = len(shared_engine.calls)
+
+    # Four private sidecars: one engine each, same concurrent load.
+    private_engines = [FakeEngine() for _ in range(4)]
+    servers = [
+        VerifySidecarServer(("127.0.0.1", 0), e, auth_secret=SECRET)
+        for e in private_engines
+    ]
+    for s in servers:
+        s.start()
+    clients = [
+        SidecarVerifierClient(s.address, auth_secret=SECRET) for s in servers
+    ]
+    try:
+        drive(clients)
+    finally:
+        for c in clients:
+            c.close()
+        for s in servers:
+            s.stop()
+    private_launches = sum(len(e.calls) for e in private_engines)
+
+    assert private_launches == 4
+    assert shared_launches < private_launches, (
+        f"shared server did not coalesce: {shared_launches} launches"
+    )
+    # The pinned metrics agree with the engine's own count.
+    dump = provider.dump()
+    assert dump[SIDECAR_WAVE_LAUNCHES_KEY]["value"] == shared_launches
+    assert dump[SIDECAR_WAVE_SIGNATURES_KEY]["value"] == 40
+    assert dump[SIDECAR_WAVE_TENANTS_KEY]["value"] >= 4
+    # Per-tenant kernel attribution: every tenant rode its 10 signatures.
+    snap = accounting.snapshot()
+    assert set(snap) == set(TENANTS)
+    for stats in snap.values():
+        assert stats["signatures"] == 10 and stats["waves"] >= 1
+
+
+def test_admission_reject_is_structured_and_never_stalls_other_tenants():
+    """A tenant over its queue limit gets an IMMEDIATE structured status-2
+    reject (tenant id, queue depth, limit); a concurrent honest tenant's
+    wave still launches and completes.  With a local engine the rejected
+    tenant falls back locally WITHOUT marking the sidecar suspect."""
+    import time
+
+    from consensus_tpu.metrics import (
+        SIDECAR_ADMISSION_REJECTS_KEY,
+        InMemoryProvider,
+        Metrics,
+    )
+    from consensus_tpu.net.sidecar import TenantAdmissionReject
+
+    provider = InMemoryProvider()
+    metrics = Metrics(provider, label_names=("tenant",))
+    engine = FakeEngine()
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), engine, tenants=TENANTS,
+        wave_window=0.02, tenant_queue_limit=16, metrics=metrics.sidecar,
+    )
+    server.start()
+    flooder = _tenant_client(server.address, "alpha")
+    honest = _tenant_client(server.address, "beta")
+    try:
+        outs = {}
+
+        def honest_worker():
+            outs["beta"] = honest.verify_batch(
+                [b"m"] * 8, [b"good"] * 8, [b"k"] * 8
+            )
+
+        t = threading.Thread(target=honest_worker)
+        t.start()
+        start = time.monotonic()
+        with pytest.raises(TenantAdmissionReject) as exc:
+            flooder.verify_batch([b"m"] * 20, [b"good"] * 20, [b"k"] * 20)
+        reject_latency = time.monotonic() - start
+        t.join(timeout=10.0)
+        assert outs["beta"].all(), "honest tenant stalled behind the reject"
+        assert exc.value.tenant == "alpha"
+        assert exc.value.limit == 16
+        assert reject_latency < 5.0, "reject must not wait out a stall budget"
+        assert not flooder._suspect, "admission reject must not mark suspect"
+        assert provider.dump()[SIDECAR_ADMISSION_REJECTS_KEY]["value"] >= 1
+
+        # With a local engine the over-quota tenant degrades gracefully.
+        local = FakeEngine()
+        fallback = _tenant_client(
+            server.address, "alpha", local_engine=local,
+        )
+        out = fallback.verify_batch([b"m"] * 20, [b"good"] * 20, [b"k"] * 20)
+        assert out.all() and local.calls == [20]
+        assert not fallback._suspect
+        fallback.close()
+    finally:
+        flooder.close()
+        honest.close()
+        server.stop()
+
+
+def test_give_up_queued_raises_structured_sidecar_stall():
+    """The client give-up path (budget spent behind a stalled sender,
+    wire never touched) must raise the STRUCTURED SidecarQueueStall —
+    tenant id, local queue depth, expired budget — and still satisfy the
+    legacy QueueStallTimeout isinstance contract."""
+    from consensus_tpu.net.sidecar import QueueStallTimeout, SidecarQueueStall
+
+    engine = FakeEngine()
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), engine, tenants=TENANTS, wave_window=0.002,
+    )
+    server.start()
+    client = _tenant_client(server.address, "gamma", request_timeout=0.3)
+    try:
+        # Prime the connection, then hold the write lock so the next call
+        # burns its whole budget queued behind a "stalled sender".
+        assert client.verify_batch([b"m"], [b"good"], [b"k"]).all()
+        client._wlock.acquire()
+        try:
+            with pytest.raises(QueueStallTimeout) as exc:
+                client.verify_batch([b"m"], [b"good"], [b"k"])
+        finally:
+            client._wlock.release()
+        stall = exc.value
+        assert isinstance(stall, SidecarQueueStall)
+        assert stall.tenant == "gamma"
+        assert stall.deadline == pytest.approx(0.3)
+        assert stall.queue_depth == 0  # nothing else was in flight
+        assert not client._suspect, "queue stall must not mark suspect"
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_tenant_mode_requires_secret():
+    with pytest.raises(ValueError, match="tenant mode requires"):
+        SidecarVerifierClient(("127.0.0.1", 1), tenant="alpha")
+
+
+def test_tenant_isolation_under_chaos_flood():
+    """Satellite of the multi-tenant PR: a flooding tenant hammering the
+    shared verification service with over-quota sweeps is admission-rejected
+    (status 2, bounded queue) while an honest tenant's REAL-crypto consensus
+    cluster — running a lossy, delayed, byzantine chaos schedule THROUGH the
+    shared sidecar — keeps committing, and the obs ``verify_collapse``
+    detector stays silent for every honest node: the flood never starves
+    their verify launches."""
+    from consensus_tpu.config import ObsConfig
+    from consensus_tpu.models import Ed25519BatchVerifier
+    from consensus_tpu.net.sidecar import TenantAdmissionReject
+    from consensus_tpu.testing.chaos import (
+        ChaosAction,
+        ChaosEngine,
+        ChaosSchedule,
+    )
+
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0),
+        Ed25519BatchVerifier(min_device_batch=10**9),
+        tenants={"honest": b"honest-secret", "flood": b"flood-secret"},
+        wave_window=0.001,
+        tenant_queue_limit=64,
+    )
+    server.start()
+
+    stop = threading.Event()
+    rejects = [0]
+
+    def flood():
+        client = SidecarVerifierClient(
+            server.address, auth_secret=b"flood-secret", tenant="flood",
+            request_timeout=5.0,
+        )
+        try:
+            while not stop.is_set():
+                try:
+                    client.verify_batch(
+                        [b"junk"] * 100, [bytes(64)] * 100, [bytes(32)] * 100
+                    )
+                except TenantAdmissionReject:
+                    rejects[0] += 1
+                except Exception:
+                    pass
+        finally:
+            client.close()
+
+    flooder = threading.Thread(target=flood, daemon=True)
+    flooder.start()
+    try:
+        def honest_engine():
+            return SidecarVerifierClient(
+                server.address, auth_secret=b"honest-secret", tenant="honest",
+                local_engine=Ed25519BatchVerifier(min_device_batch=10**9),
+            )
+
+        # Loss, delay, and a signature-corrupting byzantine node — but no
+        # partition/crash, so any verify_collapse firing could only come
+        # from the flood starving honest verify launches.
+        schedule = ChaosSchedule(
+            seed=23,
+            n=4,
+            actions=(
+                ChaosAction(at=20.0, kind="loss",
+                            args={"a": 1, "b": 3, "p": 0.1}),
+                ChaosAction(at=30.0, kind="byzantine",
+                            args={"node": 4, "rate": 0.5}),
+                ChaosAction(at=60.0, kind="delay",
+                            args={"a": 2, "b": 4, "d": 0.5}),
+                ChaosAction(at=90.0, kind="heal"),
+            ),
+        )
+        result = ChaosEngine(
+            schedule, crypto="ed25519", engine_factory=honest_engine,
+            obs=ObsConfig(enabled=True, sample_interval=5.0),
+        ).run()
+    finally:
+        stop.set()
+        flooder.join(timeout=10.0)
+        server.stop()
+
+    assert result.ok, result.violation
+    assert rejects[0] > 0, "the flooding tenant was never admission-rejected"
+    collapse = [a for a in result.anomalies if a.kind == "verify_collapse"]
+    assert not collapse, f"flood starved honest verify launches: {collapse}"
