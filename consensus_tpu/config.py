@@ -140,6 +140,17 @@ class Configuration:
     # the verdict (the host-mesh parity gate pins this).
     mesh_shards: int = 1
 
+    # --- membership epochs (no reference counterpart) -------------------
+    # Stamp outbound consensus traffic with the sender's membership epoch
+    # (wire.EpochTagged) and drop inbound traffic from other epochs at the
+    # facade ingress — counted under the pinned membership_stale_epoch_
+    # dropped metric, with a trace instant, instead of corrupting
+    # collectors or provoking spurious view changes.  Default off: tagging
+    # wraps every wire message, so all replicas in a cluster must agree on
+    # this flag (a tagged message is still UNWRAPPED by a non-tagging
+    # receiver, but an untagged sender gets no protection).
+    epoch_tagging: bool = False
+
     # --- decision-lifecycle tracing (no reference counterpart) ----------
     trace: TraceConfig = field(default=TraceConfig())
 

@@ -40,7 +40,12 @@ from consensus_tpu.metrics import Metrics
 from consensus_tpu.runtime.scheduler import Scheduler
 from consensus_tpu.trace.tracer import tracer_from_config
 from consensus_tpu.types import Checkpoint, Proposal, Reconfig, Signature
-from consensus_tpu.wire import ConsensusMessage, ViewMetadata, decode_view_metadata
+from consensus_tpu.wire import (
+    ConsensusMessage,
+    EpochTagged,
+    ViewMetadata,
+    decode_view_metadata,
+)
 
 logger = logging.getLogger("consensus_tpu.consensus")
 
@@ -71,6 +76,16 @@ class Consensus:
         self.config = config
         self.scheduler = scheduler
         self.comm = comm
+        #: The membership epoch this replica believes it is in.  Epoch 0 is
+        #: the boot membership; every applied membership-change Reconfig that
+        #: carries a ``membership`` config advances it.
+        self.membership_epoch = 0
+        if config.epoch_tagging:
+            # Stamp outbound consensus traffic with our epoch; ingress
+            # (handle_message) drops other epochs before they reach the
+            # collectors.  The wrapper reads membership_epoch live, so the
+            # post-reconfig rebuild needs no re-wiring.
+            self.comm = _EpochStampingComm(comm, self)
         self.application = application
         self.assembler = assembler
         self.wal = wal
@@ -270,6 +285,7 @@ class Consensus:
             tracer=self.tracer,
         )
         self.controller = controller
+        controller.membership_epoch = self.membership_epoch
 
         pool_options = PoolOptions(
             pool_size=cfg.request_pool_size,
@@ -426,12 +442,24 @@ class Consensus:
             return
         if reconfig.current_config is not None:
             self.config = reconfig.current_config
+        membership = getattr(reconfig, "membership", None)
+        if membership is not None:
+            self.membership_epoch = membership.epoch
+            self.metrics.membership.epoch.set(membership.epoch)
+            logger.info(
+                "%d: entering membership epoch %d (nodes %s)",
+                self.config.self_id, membership.epoch, list(new_nodes),
+            )
 
         # Stop the old machinery, but only pause pool timers (requests
         # survive reconfiguration).
         if self.view_changer is not None:
             self.view_changer.stop()
         self.controller.stop(pool_pause_only=True)
+        # Pipelined slots above the reconfig decision are abandoned (the new
+        # epoch's leader re-proposes their batches); hand their pool
+        # reservations back or those requests are stuck until auto-remove.
+        self.pool.release_reservations()
         self.collector.close()
 
         self.nodes = new_nodes
@@ -464,14 +492,43 @@ class Consensus:
         )
 
     def handle_message(self, sender: int, msg: ConsensusMessage) -> None:
-        """Consensus traffic ingress (quorum-membership guarded).
+        """Consensus traffic ingress (quorum-membership + epoch guarded).
 
-        Parity: reference consensus.go:282-300."""
-        if not self._running or sender not in self.nodes:
+        Parity: reference consensus.go:282-300 (the epoch gate is ours)."""
+        if isinstance(msg, EpochTagged):
+            if self.config.epoch_tagging and msg.epoch != self.membership_epoch:
+                # Traffic from another epoch — a removed node that has not
+                # yet learned of its eviction, or a lagging replica.  Drop
+                # it HERE, counted and traced, so it can never corrupt the
+                # collectors or provoke a spurious view change.
+                self._drop_stale_epoch(sender, msg.epoch)
+                return
+            msg = msg.msg
+        if not self._running:
+            return
+        if sender not in self.nodes:
+            if self.config.epoch_tagging:
+                self._drop_stale_epoch(sender, None)
             return
         self.scheduler.post(
             lambda: self.controller.process_message(sender, msg), name="handle-msg"
         )
+
+    def _drop_stale_epoch(self, sender: int, epoch: Optional[int]) -> None:
+        self.metrics.membership.count_stale_epoch_dropped.add(1)
+        self.tracer.instant(
+            "membership", "membership.stale_drop", sender=sender, epoch=epoch
+        )
+        if (
+            epoch is not None
+            and epoch > self.membership_epoch
+            and self._running
+            and self.controller is not None
+        ):
+            # The SENDER is ahead of us: a membership change we have not
+            # delivered yet.  Nudge sync (idempotent) so we catch up instead
+            # of silently discarding the future.
+            self.scheduler.post(self.controller.sync, name="stale-epoch-sync")
 
     def handle_request(self, sender: int, raw: bytes) -> None:
         if not self._running or sender not in self.nodes:
@@ -488,6 +545,32 @@ class Consensus:
     def _on_pool_submitted(self) -> None:
         if self.controller is not None and not self.controller.stopped:
             self.batcher.pool_changed()
+
+
+class _EpochStampingComm:
+    """Comm decorator stamping outbound consensus traffic with the owner's
+    current membership epoch (``wire.EpochTagged``).
+
+    Reads ``consensus.membership_epoch`` at send time, so the stamp tracks
+    reconfigurations without re-wiring; transactions and the node roster
+    pass through untouched (request forwarding is epoch-agnostic — a request
+    is valid in any epoch that still pools it)."""
+
+    def __init__(self, inner: Comm, consensus: "Consensus") -> None:
+        self._inner = inner
+        self._consensus = consensus
+
+    def send_consensus(self, target_id: int, message) -> None:
+        self._inner.send_consensus(
+            target_id,
+            EpochTagged(epoch=self._consensus.membership_epoch, msg=message),
+        )
+
+    def send_transaction(self, target_id: int, request: bytes) -> None:
+        self._inner.send_transaction(target_id, request)
+
+    def nodes(self):
+        return self._inner.nodes()
 
 
 class _CommAdapter:

@@ -64,6 +64,14 @@ from consensus_tpu.wire import (
 
 logger = logging.getLogger("consensus_tpu.controller")
 
+#: TEST-ONLY seeded bug: when True, a replica IGNORES a decision that carried
+#: a reconfiguration — no rebuild, no eviction, no epoch advance — so the
+#: retired committee keeps certifying decisions after its removal.  The
+#: epoch-aware invariant monitor (testing/invariants.py) must catch the
+#: resulting quorum certs signed by evicted members.  Never set outside
+#: tests (see tests for the fixture that arms and disarms it).
+SENTINEL_STALE_MEMBERSHIP = False
+
 
 class ViewChangerPort(Protocol):
     """What the controller needs from the view changer (it is also the
@@ -139,6 +147,14 @@ class Controller:
         self._batch_outstanding = False
         self._sync_in_progress = False
         self._stopped = True
+        #: Membership epoch this controller serves (the facade stamps it
+        #: after construction; a reconfiguration builds a NEW controller).
+        self.membership_epoch = 0
+        # Set the moment a reconfiguration surfaces (decide or sync) and
+        # never cleared: the rebuild discards this instance.  While pending,
+        # queued commits for higher slots must NOT deliver — their certs
+        # belong to the retired membership (SAFETY.md §8).
+        self._reconfig_pending = False
 
     # ------------------------------------------------------------ identity
 
@@ -193,6 +209,7 @@ class Controller:
             "decisions_in_view": self.curr_decisions_in_view,
             "in_flight": v.in_flight_depth() if v is not None else 0,
             "syncing": self._sync_in_progress,
+            "epoch": self.membership_epoch,
         }
 
     # ----------------------------------------------------------- lifecycle
@@ -472,6 +489,12 @@ class Controller:
 
         Parity: reference controller.go:528-558 (decide) + 873-890 (Decide)
         + the MutuallyExclusiveDeliver guard (928-965)."""
+        if self._reconfig_pending:
+            # A reconfiguration already surfaced at a lower slot: commits
+            # queued for slots above it carry the RETIRED membership's
+            # certs.  Those slots are abandoned and re-proposed under the
+            # new epoch (the rebuild releases their pool reservations).
+            return
         reconfig = self._deliver_checked(proposal, signatures)
         self.pool.remove_requests(requests)
         self.curr_decisions_in_view += 1
@@ -479,9 +502,18 @@ class Controller:
         if reconfig.in_latest_decision:
             logger.info("%d: decision carried a reconfiguration", self.id)
             self.metrics.consensus.count_consensus_reconfig.add(1)
-            if self._on_reconfig is not None:
-                self._on_reconfig(reconfig)
-            return
+            if SENTINEL_STALE_MEMBERSHIP:
+                # Seeded bug: pretend the decision was ordinary.  The old
+                # committee keeps running — and keeps certifying.
+                logger.warning(
+                    "%d: SENTINEL_STALE_MEMBERSHIP armed; ignoring reconfig",
+                    self.id,
+                )
+            else:
+                self._reconfig_pending = True
+                if self._on_reconfig is not None:
+                    self._on_reconfig(reconfig)
+                return
 
         md = decode_view_metadata(proposal.metadata)
         self.metrics.blacklist.count.set(len(md.black_list))
@@ -547,6 +579,8 @@ class Controller:
     def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
         """Checked delivery for the view changer (its ``Application`` is the
         reference's MutuallyExclusiveDeliver wrapper — same guard here)."""
+        if self._reconfig_pending:
+            return Reconfig()
         return self._deliver_checked(proposal, signatures)
 
     def _check_if_rotate(self, blacklist: Sequence[int]) -> bool:
@@ -631,6 +665,7 @@ class Controller:
             self._tracer.end("controller", "sync")
         if response.reconfig.in_latest_decision:
             self._sync_in_progress = False
+            self._reconfig_pending = True
             if self._on_reconfig is not None:
                 self._on_reconfig(response.reconfig)
             return

@@ -1,0 +1,20 @@
+"""Membership-epoch subsystem: elastic add/remove-node as an ordered,
+quorum-certified decision with a first-class lifecycle.
+
+See epoch.py for the epoch arithmetic (which committee certifies which
+sequence) and bootstrap.py for the joining-node catch-up driver.
+"""
+
+from consensus_tpu.membership.bootstrap import JoinBootstrap
+from consensus_tpu.membership.epoch import (
+    MembershipChange,
+    MembershipConfig,
+    MembershipDirectory,
+)
+
+__all__ = [
+    "JoinBootstrap",
+    "MembershipChange",
+    "MembershipConfig",
+    "MembershipDirectory",
+]

@@ -48,12 +48,28 @@ OBS_ANOMALY_VIEW_CHANGE_STORM_KEY = "obs_anomaly_view_change_storm"
 OBS_ANOMALY_LEADER_FLAP_KEY = "obs_anomaly_leader_flap"
 OBS_ANOMALY_SYNC_LAG_KEY = "obs_anomaly_sync_lag"
 OBS_ANOMALY_VERIFY_COLLAPSE_KEY = "obs_anomaly_verify_collapse"
+OBS_ANOMALY_MEMBERSHIP_CHURN_KEY = "obs_anomaly_membership_churn"
 OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_COMMIT_STALL_KEY,
     OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
     OBS_ANOMALY_LEADER_FLAP_KEY,
     OBS_ANOMALY_SYNC_LAG_KEY,
     OBS_ANOMALY_VERIFY_COLLAPSE_KEY,
+    OBS_ANOMALY_MEMBERSHIP_CHURN_KEY,
+)
+
+#: Pinned instrument names for the membership-epoch subsystem
+#: (consensus_tpu/membership/): the facade's epoch gauge and stale-epoch
+#: ingress drops, and the joining-node bootstrap's attempt/retry counters.
+MEMBERSHIP_EPOCH_KEY = "membership_epoch"
+MEMBERSHIP_STALE_EPOCH_DROPPED_KEY = "membership_stale_epoch_dropped"
+MEMBERSHIP_JOIN_ATTEMPTS_KEY = "membership_join_attempts"
+MEMBERSHIP_JOIN_RETRIES_KEY = "membership_join_retries"
+MEMBERSHIP_KEYS = (
+    MEMBERSHIP_EPOCH_KEY,
+    MEMBERSHIP_STALE_EPOCH_DROPPED_KEY,
+    MEMBERSHIP_JOIN_ATTEMPTS_KEY,
+    MEMBERSHIP_JOIN_RETRIES_KEY,
 )
 
 #: Pinned instrument names for the multi-tenant verification sidecar
@@ -101,6 +117,16 @@ PINNED_METRIC_KEYS: dict[str, str] = {
         "detector firings: ledger height diverging from the running peers",
     OBS_ANOMALY_VERIFY_COLLAPSE_KEY:
         "detector firings: ledger growth with zero verify launches",
+    OBS_ANOMALY_MEMBERSHIP_CHURN_KEY:
+        "detector firings: membership epoch churning within the churn window",
+    MEMBERSHIP_EPOCH_KEY:
+        "membership epoch this replica is serving (gauge)",
+    MEMBERSHIP_STALE_EPOCH_DROPPED_KEY:
+        "inbound messages dropped at ingress for carrying another epoch",
+    MEMBERSHIP_JOIN_ATTEMPTS_KEY:
+        "join-bootstrap sync attempts (first try included)",
+    MEMBERSHIP_JOIN_RETRIES_KEY:
+        "join-bootstrap sync retries (attempts after the first)",
     SIDECAR_ADMISSION_ACCEPTED_KEY:
         "sidecar verification batches admitted to a tenant queue",
     SIDECAR_ADMISSION_REJECTS_KEY:
@@ -527,11 +553,50 @@ class MetricsObs(_Bundle):
             "Verify-launch-rate-collapse detector firings.",
             ln,
         )
+        self.count_anomaly_membership_churn = p.new_counter(
+            OBS_ANOMALY_MEMBERSHIP_CHURN_KEY,
+            "Membership-churn detector firings.",
+            ln,
+        )
 
     def anomaly_counter(self, kind: str) -> Counter:
         """The pinned counter for detector ``kind`` (its short name, e.g.
         ``commit_stall``) — fails loudly on an unknown kind."""
         return getattr(self, f"count_anomaly_{kind}")
+
+
+class MetricsMembership(_Bundle):
+    """Membership-epoch instruments — consensus_tpu addition, fed by the
+    facade's epoch gate (consensus.py) and the joining-node bootstrap driver
+    (membership/bootstrap.py).  The epoch gauge tracks the configuration a
+    replica is SERVING (it lags the cluster's newest epoch while the replica
+    is catching up); stale-epoch drops count ingress traffic carrying a
+    different epoch — a removed node's zombie sends land here instead of
+    perturbing the protocol."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.epoch = p.new_gauge(
+            MEMBERSHIP_EPOCH_KEY,
+            "Membership epoch this replica is serving.",
+            ln,
+        )
+        self.count_stale_epoch_dropped = p.new_counter(
+            MEMBERSHIP_STALE_EPOCH_DROPPED_KEY,
+            "Inbound messages dropped at ingress for carrying another epoch "
+            "or a non-member sender.",
+            ln,
+        )
+        self.count_join_attempts = p.new_counter(
+            MEMBERSHIP_JOIN_ATTEMPTS_KEY,
+            "Join-bootstrap sync attempts (first try included).",
+            ln,
+        )
+        self.count_join_retries = p.new_counter(
+            MEMBERSHIP_JOIN_RETRIES_KEY,
+            "Join-bootstrap sync retries (attempts after the first).",
+            ln,
+        )
 
 
 class MetricsSidecar(_Bundle):
@@ -612,6 +677,7 @@ class Metrics:
         self.sync = MetricsSync(provider, label_names)
         self.network = MetricsNetwork(provider, label_names)
         self.obs = MetricsObs(provider, label_names)
+        self.membership = MetricsMembership(provider, label_names)
         self.sidecar = MetricsSidecar(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
@@ -645,6 +711,7 @@ __all__ = [
     "MetricsSync",
     "MetricsNetwork",
     "MetricsObs",
+    "MetricsMembership",
     "MetricsSidecar",
     "extend_label_names",
     "VERIFY_LAUNCH_BATCH_KEY",
@@ -660,7 +727,13 @@ __all__ = [
     "OBS_ANOMALY_LEADER_FLAP_KEY",
     "OBS_ANOMALY_SYNC_LAG_KEY",
     "OBS_ANOMALY_VERIFY_COLLAPSE_KEY",
+    "OBS_ANOMALY_MEMBERSHIP_CHURN_KEY",
     "OBS_ANOMALY_KEYS",
+    "MEMBERSHIP_EPOCH_KEY",
+    "MEMBERSHIP_STALE_EPOCH_DROPPED_KEY",
+    "MEMBERSHIP_JOIN_ATTEMPTS_KEY",
+    "MEMBERSHIP_JOIN_RETRIES_KEY",
+    "MEMBERSHIP_KEYS",
     "SIDECAR_ADMISSION_ACCEPTED_KEY",
     "SIDECAR_ADMISSION_REJECTS_KEY",
     "SIDECAR_ADMISSION_QUEUE_DEPTH_KEY",

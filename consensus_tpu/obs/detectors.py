@@ -6,7 +6,7 @@ fixed-seed run is byte-identical across replays.  Detectors are
 EDGE-TRIGGERED: a condition fires once at onset and re-arms only after the
 condition clears, so a 300-second stall is one anomaly, not 300.
 
-The five kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
+The six kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
 
 ``commit_stall``        a running node has pending pool work but its ledger
                         has not grown for ``stall_window`` sim-seconds
@@ -21,6 +21,12 @@ The five kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
                         flat — decisions are appearing without commit-path
                         verification work (e.g. a sync catch-up burst, or a
                         verifier wedge)
+``membership_churn``    the node's membership epoch advanced
+                        ``churn_epochs``+ times within ``churn_window`` —
+                        reconfigurations landing faster than a healthy
+                        administrative cadence (an elastic-membership run
+                        gone thrashy, or an adversary replaying admin
+                        traffic)
 """
 
 from __future__ import annotations
@@ -35,6 +41,7 @@ ANOMALY_KINDS = (
     "leader_flap",
     "sync_lag",
     "verify_collapse",
+    "membership_churn",
 )
 
 
@@ -49,12 +56,17 @@ class DetectorThresholds:
     flap_window: float = 60.0
     lag_decisions: int = 5
     collapse_decisions: int = 3
+    churn_epochs: int = 2
+    churn_window: float = 120.0
 
     def validate(self) -> None:
         if self.stall_window <= 0 or self.storm_window <= 0 or self.flap_window <= 0:
             raise ValueError("detector windows must be positive")
+        if self.churn_window <= 0:
+            raise ValueError("detector windows must be positive")
         if min(self.storm_views, self.flap_changes,
-               self.lag_decisions, self.collapse_decisions) < 1:
+               self.lag_decisions, self.collapse_decisions,
+               self.churn_epochs) < 1:
             raise ValueError("detector counts must be >= 1")
 
 
@@ -82,6 +94,7 @@ class _NodeState:
     __slots__ = (
         "stall_since", "last_ledger", "view_changes", "leader_changes",
         "last_view", "last_leader", "collapse_base",
+        "epoch_changes", "last_epoch",
     )
 
     def __init__(self) -> None:
@@ -92,6 +105,8 @@ class _NodeState:
         self.last_view: Optional[int] = None
         self.last_leader: Optional[int] = None
         self.collapse_base: Optional[tuple[int, float]] = None  # (ledger, launches)
+        self.epoch_changes: deque = deque()    # (t, epoch)
+        self.last_epoch: Optional[int] = None
 
 
 class DetectorBank:
@@ -191,6 +206,21 @@ class DetectorBank:
             self._edge(
                 fired, "sync_lag", nid, t, lag >= th.lag_decisions,
                 f"{lag} decisions behind the tallest running peer",
+            )
+
+            # --- membership churn --------------------------------------
+            epoch = h.get("epoch", -1)
+            if running and epoch >= 0:
+                if st.last_epoch is not None and epoch != st.last_epoch:
+                    st.epoch_changes.append((t, epoch))
+                st.last_epoch = epoch
+            while st.epoch_changes and t - st.epoch_changes[0][0] > th.churn_window:
+                st.epoch_changes.popleft()
+            self._edge(
+                fired, "membership_churn", nid, t,
+                len(st.epoch_changes) >= th.churn_epochs,
+                f"{len(st.epoch_changes)} membership epoch changes within "
+                f"{th.churn_window:g}s (now serving epoch {epoch})",
             )
 
             # --- verify-launch-rate collapse ---------------------------

@@ -19,7 +19,7 @@ _NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 #: Health fields exported as ``obs_health_<field>{node="..."}`` gauges.
 HEALTH_FIELDS = (
     "running", "view", "leader", "seq", "in_flight", "syncing",
-    "pool", "wal_entries", "wal_fsyncs", "ledger", "sync_lag",
+    "pool", "wal_entries", "wal_fsyncs", "ledger", "sync_lag", "epoch",
 )
 
 

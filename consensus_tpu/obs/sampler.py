@@ -175,6 +175,7 @@ class ClusterSampler:
             "wal_fsyncs": -1,
             "ledger": ledger,
             "sync_lag": max(0, max_height - ledger),
+            "epoch": -1,
         }
         wal = getattr(node, "wal", None)
         entries = getattr(wal, "entries", None)
@@ -191,6 +192,7 @@ class ClusterSampler:
             h["seq"] = int(ch["seq"])
             h["in_flight"] = int(ch["in_flight"])
             h["syncing"] = bool(ch["syncing"])
+            h["epoch"] = int(ch["epoch"])
             pool = getattr(cons, "pool", None)
             if pool is not None:
                 h["pool"] = int(pool.count)

@@ -36,6 +36,11 @@ from consensus_tpu.testing.invariants import (
     Violation,
     is_known_unresolvable_split,
 )
+from consensus_tpu.testing.membership import (
+    boot_node,
+    install_reconfig_hook,
+    reconfig_request,
+)
 from consensus_tpu.testing.network import INJECTED_EVENT_KINDS, NodeComm, SimNetwork
 
 __all__ = [
@@ -69,4 +74,7 @@ __all__ = [
     "unpack_batch",
     "SimNetwork",
     "NodeComm",
+    "boot_node",
+    "install_reconfig_hook",
+    "reconfig_request",
 ]

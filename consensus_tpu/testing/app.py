@@ -25,6 +25,7 @@ from consensus_tpu.api.deps import (
 from consensus_tpu.config import Configuration
 from consensus_tpu.consensus import Consensus
 from consensus_tpu.core.view import Phase  # noqa: F401  (re-export convenience)
+from consensus_tpu.membership import JoinBootstrap
 from consensus_tpu.runtime.scheduler import SimScheduler
 from consensus_tpu.sync import (
     InProcessSyncTransport,
@@ -325,6 +326,8 @@ class Node:
         #: over the surviving app ledger.
         self.sync_server: Optional[SyncServer] = None
         self.synchronizer = None
+        #: membership.JoinBootstrap armed by Cluster.add_node(bootstrap=True).
+        self.join_bootstrap = None
 
     def arm_fault_plan(self, plan) -> None:
         """Arm ``plan`` on this node: its crash seams will call
@@ -486,25 +489,23 @@ class Cluster:
         self.sync_servers: dict[int, SyncServer] = {}
         self.scheduler = SimScheduler()
         self.network = SimNetwork(self.scheduler, seed=seed)
-        self.network.membership = list(range(1, n + 1))
+        self.network.set_membership(list(range(1, n + 1)), epoch=0)
         self.nodes: dict[int, Node] = {}
         #: fn(node_id, Decision) called on every COMMIT-PATH delivery (not
         #: on sync appends) — the invariant monitor's wiring point.
         self.delivery_hooks: list = []
         #: proposal-digest -> Reconfig to report on delivery (reconfig tests).
         self._reconfigs: dict[str, Reconfig] = {}
-        tweaks = dict(config_tweaks or {})
+        #: membership.MembershipDirectory once the reconfig harness
+        #: (testing/membership.py install_reconfig_hook) is installed.
+        self.membership_directory = None
+        #: fn(Proposal) -> Reconfig; consulted by :meth:`reconfig_of` after
+        #: the explicit-digest table (the harness's payload interpreter).
+        self._membership_interpreter = None
+        self._config_tweaks = dict(config_tweaks or {})
+        self._leader_rotation = leader_rotation
         for node_id in range(1, n + 1):
-            cfg = Configuration(
-                self_id=node_id,
-                leader_rotation=leader_rotation,
-                decisions_per_leader=tweaks.pop("decisions_per_leader", 3)
-                if leader_rotation
-                else 0,
-                **tweaks,
-            )
-            tweaks = dict(config_tweaks or {})  # fresh copy per node
-            self.nodes[node_id] = Node(node_id, self, cfg)
+            self.nodes[node_id] = Node(node_id, self, self._node_config(node_id))
         #: Observability plane — DEFAULT OFF.  Pass an ``ObsConfig`` with
         #: ``enabled=True`` to build a ClusterSampler here (pre-start, so
         #: the installed metrics providers reach the Consensus builds) and
@@ -521,11 +522,88 @@ class Cluster:
                 thresholds=obs.detector_thresholds,
             )
 
+    def _node_config(self, node_id: int) -> Configuration:
+        """Build a node's Configuration from the cluster-wide tweaks (the
+        same recipe the constructor uses, so a node added later matches the
+        boot-time ones)."""
+        tweaks = dict(self._config_tweaks)
+        return Configuration(
+            self_id=node_id,
+            leader_rotation=self._leader_rotation,
+            decisions_per_leader=tweaks.pop("decisions_per_leader", 3)
+            if self._leader_rotation
+            else 0,
+            **tweaks,
+        )
+
     def start(self) -> None:
         for node in self.nodes.values():
             node.start()
         if self.sampler is not None:
             self.sampler.start()
+
+    # --- dynamic membership ------------------------------------------------
+
+    def add_node(self, node_id: int, *, bootstrap: bool = True) -> Node:
+        """Boot a node admitted by an ordered grow decision.
+
+        Always builds a FRESH Node (empty ledger, empty WAL) with the
+        cluster-wide config recipe: a joiner — even a re-added id — is a
+        new process that must sync the whole history over the wire.  With
+        ``bootstrap=True`` and the reconfig harness installed, arms a
+        :class:`~consensus_tpu.membership.JoinBootstrap` so the joiner
+        drives wire sync with retry/backoff until it reaches the current
+        membership epoch (surviving injected loss and epochs advancing
+        mid-join).
+        """
+        node = Node(node_id, self, self._node_config(node_id))
+        self.nodes[node_id] = node
+        if self.sampler is not None and node.metrics is None:
+            # Same pre-start install the sampler does for boot-time nodes.
+            from consensus_tpu.metrics import InMemoryProvider, Metrics
+
+            node.metrics = Metrics(InMemoryProvider())
+        node.start()
+        directory = self.membership_directory
+        if bootstrap and directory is not None:
+            bootstrapper = JoinBootstrap(
+                self.scheduler,
+                sync=lambda: (
+                    node.consensus.controller.sync()
+                    if node.consensus is not None and node.consensus._running
+                    else None
+                ),
+                caught_up=lambda: (
+                    node.consensus is None
+                    or not node.consensus._running
+                    or node.consensus.membership_epoch >= directory.current_epoch
+                ),
+                current_epoch=lambda: directory.current_epoch,
+                metrics=node.metrics.membership if node.metrics is not None else None,
+            )
+            node.join_bootstrap = bootstrapper
+            bootstrapper.start()
+        return node
+
+    def remove_node(self, node_id: int) -> None:
+        """Retire a node evicted by an ordered shrink decision.
+
+        The eviction must already have been ORDERED AND DELIVERED (the
+        node's consensus self-shuts-down when it applies the Reconfig that
+        drops it) — this method only retires the harness-level process.
+        The node deliberately STAYS registered on the network: a removed-
+        but-live process keeps transmitting, which is exactly the
+        stale-epoch traffic the facade's epoch gate must drop-and-count.
+        """
+        node = self.nodes[node_id]
+        assert node.consensus is None or not node.consensus._running, (
+            f"node {node_id} is still running consensus — remove-node must be "
+            f"ordered as a decision and delivered (self-eviction) first"
+        )
+        bootstrapper = getattr(node, "join_bootstrap", None)
+        if bootstrapper is not None:
+            bootstrapper.stop()
+        node.running = False
 
     # --- app-level cluster state ------------------------------------------
 
@@ -543,7 +621,15 @@ class Cluster:
         return list(best)
 
     def reconfig_of(self, proposal: Proposal) -> Reconfig:
-        return self._reconfigs.get(proposal.digest(), Reconfig())
+        # Stable METHOD (never replaced): LedgerSynchronizer captures it as
+        # a bound method at Node.start, so the interpreter chain must live
+        # inside it rather than in a swapped-out attribute.
+        hit = self._reconfigs.get(proposal.digest())
+        if hit is not None:
+            return hit
+        if self._membership_interpreter is not None:
+            return self._membership_interpreter(proposal)
+        return Reconfig()
 
     # --- driving -----------------------------------------------------------
 

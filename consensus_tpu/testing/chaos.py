@@ -34,6 +34,16 @@ Adversary vocabulary (``ChaosAction.kind``):
                                 per-SENDER message mutation (≤ f senders)
 ``arm_fault``                   arm a WAL/state/sync crash point from the
                                 FaultPlan catalog (testing/faults.py)
+``add_node`` / ``remove_node``  elastic membership (``generate(churn=True)``
+                                only): order a reconfiguration through the
+                                protocol itself, then boot the joiner /
+                                retire the evictee.  A schedule containing
+                                churn actions makes the engine install the
+                                membership harness
+                                (``install_reconfig_hook``) and turn on
+                                ``epoch_tagging``; ``generate(churn=False)``
+                                draws a byte-identical schedule to before
+                                the vocabulary existed.
 
 Everything runs on the SimScheduler's virtual clock — no wall-clock reads
 anywhere (scripts/check_no_wallclock.py lints this module too).
@@ -52,7 +62,13 @@ from consensus_tpu.testing.invariants import (
     Violation,
     is_known_unresolvable_split,
 )
+from consensus_tpu.testing.membership import install_reconfig_hook, reconfig_request
 from consensus_tpu.utils.quorum import compute_quorum
+from consensus_tpu.wire import EpochTagged
+
+#: The churn vocabulary: actions that change the member set through an
+#: ordered reconfiguration (not a topology knob).
+CHURN_KINDS = ("add_node", "remove_node")
 
 #: The soak suite's fast-timeout profile; chaos runs use the same one so a
 #: 25-action schedule finishes in well under a sim-hour.
@@ -108,11 +124,18 @@ class ChaosSchedule:
         steps: int = 25,
         durability_window: float = 0.0,
         start: float = 30.0,
+        churn: bool = False,
     ) -> "ChaosSchedule":
         """Derive a feasible schedule from ``seed``: action times are
         cumulative uniform(5, 40) gaps from ``start``, kinds are weighted
         draws constrained so the adversary stays inside the fault model
-        (≤ f replicas down or doomed at once, ≤ f byzantine senders)."""
+        (≤ f replicas down or doomed at once, ≤ f byzantine senders).
+
+        ``churn=True`` adds ``add_node`` / ``remove_node`` to the
+        vocabulary (bounded: member set never below 4 or more than two
+        above ``n``, removes only target live non-byzantine members);
+        ``churn=False`` leaves every RNG draw byte-identical to the
+        pre-churn generator, so pinned schedules replay unchanged."""
         rng = random.Random(seed)
         ids = list(range(1, n + 1))
         _, f = compute_quorum(n)
@@ -120,13 +143,31 @@ class ChaosSchedule:
                  "duplicate", "reorder", "replay", "byzantine",
                  "byzantine_stop", "arm_fault"]
         weights = [2.0, 2.0, 1.5, 2.0, 2.0, 1.5, 1.5, 1.5, 1.5, 1.0, 1.0, 1.0]
+        if churn:
+            kinds += list(CHURN_KINDS)
+            weights += [1.2, 1.2]
+        members = set(ids)
+        next_id = n + 1
         t = start
         down: set[int] = set()  # crashed or armed-to-crash
         byzantine: set[int] = set()
         actions = []
         for _ in range(steps):
             t += rng.uniform(5.0, 40.0)
+            if churn:
+                # Feasibility tracks the CURRENT member set, not the seed
+                # shape: targets are drawn from live members and the fault
+                # budget follows the shrunken/grown committee.
+                ids = sorted(members)
+                _, f = compute_quorum(len(ids))
             kind = rng.choices(kinds, weights)[0]
+            if kind == "add_node" and len(members) - n >= 2:
+                kind = "remove_node"
+            if kind == "remove_node":
+                evictable = [i for i in sorted(members)
+                             if i not in down and i not in byzantine]
+                if len(members) <= 4 or not evictable:
+                    kind = "heal"
             # Feasibility downgrades keep every generated action applicable
             # (the engine re-checks at run time anyway — shrunk subsets may
             # still strand a restart whose crash was deleted).
@@ -175,6 +216,18 @@ class ChaosSchedule:
             elif kind == "byzantine_stop":
                 byzantine.clear()
                 actions.append(ChaosAction(at=t, kind="byzantine_stop"))
+            elif kind == "add_node":
+                node = next_id
+                next_id += 1
+                members.add(node)
+                actions.append(ChaosAction(at=t, kind="add_node",
+                                           args={"node": node}))
+            elif kind == "remove_node":
+                node = rng.choice(evictable)
+                members.discard(node)
+                down.discard(node)
+                actions.append(ChaosAction(at=t, kind="remove_node",
+                                           args={"node": node}))
             else:  # arm_fault: the armed replica dies at the seam firing
                 node = rng.choice([i for i in ids if i not in down])
                 down.add(node)
@@ -220,6 +273,10 @@ class ChaosEngine:
     PROBE_REQUESTS = 5
     WARMUP_BUDGET = 300.0
     SETTLE_TIME = 60.0
+    #: Sim-time allowed for one churn action's reconfiguration to ORDER
+    #: (epoch advance observed) and, for removes, for the evictee to
+    #: deliver its own eviction and shut down.
+    RECONFIG_BUDGET = 300.0
     #: Bounded time-to-progress after the last disruptive action: n - f
     #: replicas must extend the ledger within this much sim-time of the
     #: post-schedule heal (the liveness invariant's budget).
@@ -258,6 +315,12 @@ class ChaosEngine:
             raise ValueError("engine_factory requires a crypto mode")
         self.schedule = schedule
         self.config_tweaks = dict(config_tweaks or DEFAULT_TWEAKS)
+        #: A schedule carrying churn actions runs with the membership
+        #: harness installed and epoch tagging on — stale-epoch traffic
+        #: from evictees must be dropped at ingress, not interpreted.
+        self._churn = any(a.kind in CHURN_KINDS for a in schedule.actions)
+        if self._churn:
+            self.config_tweaks.setdefault("epoch_tagging", True)
         self.check_durability = check_durability
         self.metrics = metrics
         self.tracer = tracer
@@ -307,21 +370,30 @@ class ChaosEngine:
 
     def _apply(self, action: ChaosAction) -> bool:
         """Apply one action if currently feasible; False means skipped
-        (shrunk subsets legitimately strand restarts and byzantine_stops)."""
+        (shrunk subsets legitimately strand restarts, byzantine_stops, and
+        churn actions whose prerequisite add/remove was deleted)."""
         net = self.cluster.network
         nodes = self.cluster.nodes
-        _, f = compute_quorum(self.schedule.n)
-        dead = sum(1 for nd in nodes.values() if not nd.running)
+        members = set(net.node_ids())
+        _, f = compute_quorum(len(members))
+        # The fault budget covers MEMBERS only: an evicted node kept around
+        # for its ledger is not a crash the protocol must tolerate.
+        dead = sum(
+            1 for nid, nd in nodes.items()
+            if nid in members and not nd.running
+        )
         kind, args = action.kind, action.args
         if kind == "crash":
-            node = nodes[args["node"]]
+            node = nodes.get(args["node"])
+            if node is None or args["node"] not in members:
+                return False
             if not node.running or dead >= f:
                 return False
             node.crash()
             return True
         if kind == "restart":
-            node = nodes[args["node"]]
-            if node.running:
+            node = nodes.get(args["node"])
+            if node is None or args["node"] not in members or node.running:
                 return False
             node.restart()
             return True
@@ -358,8 +430,37 @@ class ChaosEngine:
                 return False
             self._byz_rules.clear()
             return True
+        if kind == "add_node":
+            node_id = args["node"]
+            if node_id in nodes or node_id in members:
+                return False
+            if not self._order_reconfig(sorted(members | {node_id})):
+                return False
+            self.cluster.add_node(node_id)
+            return True
+        if kind == "remove_node":
+            node_id = args["node"]
+            node = nodes.get(node_id)
+            if node is None or node_id not in members or len(members) <= 4:
+                return False
+            if not node.running:
+                return False  # eviction must be DELIVERED, not assumed
+            if not self._order_reconfig(sorted(members - {node_id})):
+                return False
+            # The evictee delivers its own eviction decision and shuts
+            # itself down; only then is retiring the process legitimate.
+            self.cluster.scheduler.run_until(
+                lambda: node.consensus is None or not node.consensus._running,
+                max_time=self.RECONFIG_BUDGET,
+            )
+            if node.consensus is not None and node.consensus._running:
+                return False  # stranded (e.g. partitioned evictee): leave it
+            self.cluster.remove_node(node_id)
+            return True
         if kind == "arm_fault":
-            node = nodes[args["node"]]
+            node = nodes.get(args["node"])
+            if node is None or args["node"] not in members:
+                return False
             if not node.running or node.fault_plan is not None or dead >= f:
                 return False
             plan = FaultPlan(args["point"], on_hit=args["hit"],
@@ -370,13 +471,43 @@ class ChaosEngine:
             return True
         raise ValueError(f"unknown chaos action kind {kind!r}")
 
+    def _order_reconfig(self, target_nodes) -> bool:
+        """Submit a membership-change request and run until SOME replica
+        surfaces the decision (directory epoch advance).  False means the
+        change did not order within the budget — the action is reported
+        skipped, though the request stays pooled and may still order later
+        (the final probe re-reads the member set, so a late reconfig is
+        picked up there)."""
+        directory = self.cluster.membership_directory
+        before = directory.current_epoch
+        self.cluster.submit_to_all(
+            reconfig_request(f"chaos-{self._submitted}", target_nodes)
+        )
+        self._submitted += 1
+        return self.cluster.scheduler.run_until(
+            lambda: directory.current_epoch > before,
+            max_time=self.RECONFIG_BUDGET,
+        )
+
     def _mutate(self, sender: int, target: int, msg):
         """Byzantine-SENDER mutation: messages from an armed sender are
         corrupted at its configured rate.  Validation must shed all of it;
-        ≤ f armed senders keeps this inside the threat model."""
+        ≤ f armed senders keeps this inside the threat model.  An
+        epoch-tagged envelope is mutated THROUGH: the inner message is
+        corrupted and re-wrapped under the sender's original epoch, so the
+        byzantine arm keeps attacking the protocol rather than tripping on
+        the envelope."""
         rate = self._byz_rules.get(sender)
         if not rate:
             return msg
+        if isinstance(msg, EpochTagged):
+            inner = self._mutate_body(msg.msg, rate)
+            if inner is msg.msg:
+                return msg
+            return dataclasses.replace(msg, msg=inner)
+        return self._mutate_body(msg, rate)
+
+    def _mutate_body(self, msg, rate: float):
         if self.crypto is not None:
             # Crypto-only arm: flip a signature byte — real verification
             # (strict or randomized-batch) must shed it.  Dedicated RNG so
@@ -475,6 +606,8 @@ class ChaosEngine:
             durability_window=sched.durability_window,
             obs=self.obs,
         )
+        if self._churn:
+            install_reconfig_hook(self.cluster)
         if self.metrics is not None:
             self.cluster.network.metrics = self.metrics.network
         if self.tracer is not None:
@@ -543,36 +676,42 @@ class ChaosEngine:
             self._submit(self.REQUESTS_PER_ACTION)
 
         if not self.monitor.violations:
-            # Quiesce: adversary off, everyone back, then LIVENESS — n - f
-            # replicas must make progress within the budget.
+            # Quiesce: adversary off, every MEMBER back, then LIVENESS —
+            # m - f member replicas must make progress within the budget
+            # (m follows the final member set under churn; a retired
+            # evictee is neither restarted nor counted).
             self.cluster.network.heal()
             self.cluster.network.mutate_send = None
             self._byz_rules.clear()
             self._disarm_faults()
-            for node in self.cluster.nodes.values():
-                if not node.running:
+            members = set(self.cluster.network.node_ids())
+            for nid, node in self.cluster.nodes.items():
+                if nid in members and not node.running:
                     node.restart()
             self._emit(f"{self._now():10.4f} quiesce: healed + restarted")
             self.cluster.scheduler.advance(self.SETTLE_TIME)
-            _, f = compute_quorum(sched.n)
-            floor = max(
-                len(nd.app.ledger) for nd in self.cluster.nodes.values()
-            )
+            members = set(self.cluster.network.node_ids())
+            m = len(members)
+            member_nodes = [
+                nd for nid, nd in self.cluster.nodes.items() if nid in members
+            ]
+            _, f = compute_quorum(m)
+            floor = max(len(nd.app.ledger) for nd in member_nodes)
             self._submit(self.PROBE_REQUESTS)
             target = floor + 1
             progressed = self.cluster.scheduler.run_until(
                 lambda: sum(
-                    1 for nd in self.cluster.nodes.values()
+                    1 for nd in member_nodes
                     if len(nd.app.ledger) >= target
-                ) >= sched.n - f,
+                ) >= m - f,
                 max_time=self.LIVENESS_BUDGET,
             )
             if not progressed and not is_known_unresolvable_split(
-                self.cluster, sched.n
+                self.cluster, m
             ):
                 self.monitor.record(
                     "liveness", None,
-                    f"{sched.n - f} replicas failed to reach height {target} "
+                    f"{m - f} replicas failed to reach height {target} "
                     f"within {self.LIVENESS_BUDGET}s sim-time of the final "
                     "heal (and the stall is not a known-unresolvable "
                     "prepared split)",
@@ -712,6 +851,7 @@ def format_repro(result: ChaosResult) -> str:
 
 __all__ = [
     "ARMABLE_POINTS",
+    "CHURN_KINDS",
     "ChaosAction",
     "ChaosEngine",
     "ChaosResult",

@@ -15,7 +15,14 @@ Monitored invariants (formal statements: SAFETY.md §6):
   agrees on its common prefix of proposal digests.
 * **quorum-cert** — every delivered decision carries ``>= 2f + 1``
   commit signatures from distinct consenters, each verifying against the
-  delivered proposal.
+  delivered proposal.  With a membership directory installed
+  (``install_reconfig_hook``) the quorum bar is the one of the EPOCH THE
+  DECISION BELONGS TO, and only that epoch's members count toward it.
+* **epoch-cert** — with a directory installed: no valid signer of a
+  delivered decision lies outside the membership of the decision's epoch —
+  in particular, a removed node never appears in a later quorum cert
+  (SAFETY.md §8).  Without a directory the ledgers carry no epoch
+  structure and this check is vacuous.
 * **durable-before-visible** — at the moment a replica delivers sequence
   ``s`` through the commit path, its own WAL already holds a protocol
   record binding it to that proposal at ``s`` (the persist-before-sign
@@ -50,7 +57,7 @@ class Violation:
     """One invariant failure, pinned to the sim clock and the adversary
     actions executed before it."""
 
-    invariant: str  # "prefix-agreement" | "quorum-cert" | "durable-before-visible" | "liveness"
+    invariant: str  # "prefix-agreement" | "quorum-cert" | "epoch-cert" | "durable-before-visible" | "liveness"
     sim_time: float
     node: Optional[int]
     detail: str
@@ -178,8 +185,14 @@ class InvariantMonitor:
                     return
 
     def _check_quorum_cert(self, node_id: int, decision) -> None:
-        """>= 2f+1 distinct consenters, each signature verifying against
-        the delivered proposal."""
+        """>= quorum distinct consenters, each signature verifying against
+        the delivered proposal.  Epoch-aware when the cluster carries a
+        membership directory: the quorum bar and the eligible signer set
+        are the ones of the epoch the decision's sequence falls in, and a
+        valid signer OUTSIDE that membership is its own violation
+        (``epoch-cert``) — the cert a node built from a retired committee,
+        or padded with an evicted member, is caught here even if it is
+        numerically big enough."""
         app = self.cluster.nodes[node_id].app
         valid: set[int] = set()
         bad: list[str] = []
@@ -190,13 +203,32 @@ class InvariantMonitor:
                 bad.append(f"id={sig.id}: {err}")
                 continue
             valid.add(sig.id)
-        if len(valid) < self.quorum:
+        seq = _seq_of(decision.proposal)
+        quorum = self.quorum
+        directory = getattr(self.cluster, "membership_directory", None)
+        if directory is not None:
+            cfg = directory.membership_at(seq)
+            quorum = cfg.quorum
+            members = set(cfg.nodes)
+            foreign = sorted(valid - members)
+            if foreign:
+                evicted = sorted(set(foreign) & directory.ever_removed())
+                self.record(
+                    "epoch-cert",
+                    node_id,
+                    f"decision at seq {seq} (epoch {cfg.epoch}, members "
+                    f"{list(cfg.nodes)}) carries valid signature(s) from "
+                    f"non-member(s) {foreign}"
+                    + (f", previously removed: {evicted}" if evicted else ""),
+                )
+            valid &= members
+        if len(valid) < quorum:
             self.record(
                 "quorum-cert",
                 node_id,
-                f"decision at seq {_seq_of(decision.proposal)} delivered with "
+                f"decision at seq {seq} delivered with "
                 f"{len(valid)} distinct valid commit signature(s) "
-                f"(quorum is {self.quorum}"
+                f"(quorum is {quorum}"
                 + (f"; invalid: {'; '.join(bad)}" if bad else "")
                 + ")",
             )
@@ -263,6 +295,8 @@ def is_known_unresolvable_split(cluster, n: int) -> bool:
 
     msgs = []
     for node in cluster.nodes.values():
+        if not node.running or node.consensus is None:
+            continue  # a retired (evicted) replica argues no camp
         vc = node.consensus.view_changer
         svd = vc._prepare_view_data()
         msgs.append(decode_view_data(svd.raw_view_data))

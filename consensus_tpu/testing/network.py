@@ -57,8 +57,22 @@ class SimNetwork:
         self.default_delay = default_delay
         self._handlers: dict[int, Callable[[int, object, bool], None]] = {}
         #: Configured cluster membership (stable across crashes); falls back
-        #: to the live registration set when unset.
+        #: to the live registration set when unset.  Mutate ONLY through
+        #: :meth:`set_membership` — the setter keeps the removed-node
+        #: accounting and the epoch counter consistent.
         self.membership: Optional[list[int]] = None
+        #: Bumped by every :meth:`set_membership` call (or pinned to the
+        #: caller's epoch): lets assertions tie network-level membership to
+        #: the protocol's membership epoch.
+        self.membership_epoch = 0
+        #: Ids removed from membership whose in-flight / future deliveries
+        #: are dropped-and-counted rather than silently lost.
+        self._removed: set[int] = set()
+        #: In-flight deliveries to removed nodes that were accounted for
+        #: (the membership analogue of :attr:`injected`, but NOT an
+        #: injected-adversary event — removal is topology, so it gets its
+        #: own counter instead of a new INJECTED_EVENT_KINDS entry).
+        self.removed_drops = 0
         self._disconnected: set[int] = set()
         self._cut_links: set[tuple[int, int]] = set()
         self._loss: dict[tuple[int, int], float] = {}
@@ -101,6 +115,29 @@ class SimNetwork:
         if self.membership is not None:
             return sorted(self.membership)
         return sorted(self._handlers)
+
+    def set_membership(
+        self, ids: Sequence[int], *, epoch: Optional[int] = None
+    ) -> None:
+        """The one supported way to change :attr:`membership`.
+
+        Ids leaving the member set are tracked in ``_removed`` so their
+        in-flight deliveries (already scheduled on the sim clock) are
+        DROPPED AND COUNTED at delivery time instead of vanishing; a
+        re-added id is un-tracked.  ``epoch`` pins the epoch counter (the
+        harness passes the directory's epoch); omitted, it increments.
+        """
+        new = set(ids)
+        old = set(self.membership) if self.membership is not None else set(
+            self._handlers
+        )
+        self._removed |= old - new
+        self._removed -= new
+        self.membership = sorted(new)
+        if epoch is not None:
+            self.membership_epoch = epoch
+        else:
+            self.membership_epoch += 1
 
     # --- fault injection ---------------------------------------------------
 
@@ -250,6 +287,13 @@ class SimNetwork:
         def deliver() -> None:
             handler = self._handlers.get(target)
             if handler is None:
+                if target in self._removed:
+                    # The target left the membership AND unregistered while
+                    # this delivery was in flight: account for the drop
+                    # instead of silently losing it.  (A removed-but-live
+                    # node still receives — it must be able to deliver the
+                    # very decision that evicts it.)
+                    self.removed_drops += 1
                 return  # crashed / removed meanwhile
             if self.lose_messages is not None and self.lose_messages(
                 target, sender, payload

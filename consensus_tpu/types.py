@@ -110,6 +110,11 @@ class Reconfig:
     in_latest_decision: bool = False
     current_nodes: tuple[int, ...] = ()
     current_config: Optional["object"] = None  # Configuration; avoid cycle
+    #: Optional membership.MembershipConfig for the epoch this decision
+    #: opens (held opaque: types must not import the membership package).
+    #: None preserves the pre-epoch Reconfig shape — consumers that only
+    #: need the node set keep reading current_nodes.
+    membership: Optional["object"] = None
 
 
 @dataclass(frozen=True)

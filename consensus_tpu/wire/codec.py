@@ -25,6 +25,7 @@ from consensus_tpu.types import Proposal, Signature
 from consensus_tpu.wire.messages import (
     Commit,
     ConsensusMessage,
+    EpochTagged,
     HeartBeat,
     HeartBeatResponse,
     NewView,
@@ -381,6 +382,21 @@ def _r_sync_snapshot_meta(r: _Reader) -> SyncSnapshotMeta:
     return SyncSnapshotMeta(height=height, last_digest=last_digest)
 
 
+def _w_epoch_tagged(w: _Writer, m: EpochTagged) -> None:
+    if isinstance(m.msg, EpochTagged):
+        raise CodecError("EpochTagged must not nest another EpochTagged")
+    w.u64(m.epoch)
+    w.blob(encode_message(m.msg))
+
+
+def _r_epoch_tagged(r: _Reader) -> EpochTagged:
+    epoch = r.u64()
+    inner = decode_message(r.blob())
+    if isinstance(inner, EpochTagged):
+        raise CodecError("EpochTagged must not nest another EpochTagged")
+    return EpochTagged(epoch=epoch, msg=inner)
+
+
 # Tag assignments mirror the reference's oneof field numbers
 # (smartbftprotos/messages.proto:15-26) for easy cross-auditing; tags 11-13
 # are ours — the reference has no sync wire protocol (Fabric's block puller
@@ -399,6 +415,8 @@ _MESSAGE_CODECS: dict[int, tuple[type, Callable, Callable]] = {
     11: (SyncRequest, _w_sync_request, _r_sync_request),
     12: (SyncChunk, _w_sync_chunk, _r_sync_chunk),
     13: (SyncSnapshotMeta, _w_sync_snapshot_meta, _r_sync_snapshot_meta),
+    # 14 is ours: the membership-epoch envelope (no reference counterpart).
+    14: (EpochTagged, _w_epoch_tagged, _r_epoch_tagged),
 }
 
 _TAG_BY_TYPE = {cls: tag for tag, (cls, _, _) in _MESSAGE_CODECS.items()}

@@ -220,6 +220,24 @@ class SyncSnapshotMeta:
     last_digest: str = ""
 
 
+@dataclass(frozen=True)
+class EpochTagged:
+    """Membership-epoch envelope around any other wire message.
+
+    When ``Configuration.epoch_tagging`` is on, every outbound consensus
+    message is wrapped with the sender's current membership epoch and the
+    receiving facade drops traffic from other epochs at ingress — counted
+    and traced, never fed to the collectors.  Exactly one level of wrapping
+    is legal (the codec rejects a nested ``EpochTagged``).
+
+    No reference counterpart: the reference leaves membership bookkeeping to
+    the application and has no epoch discriminator on the wire.
+    """
+
+    epoch: int
+    msg: "ConsensusMessage"
+
+
 #: The "Message oneof": anything a replica may put on the wire.
 ConsensusMessage = Union[
     PrePrepare,
@@ -235,6 +253,7 @@ ConsensusMessage = Union[
     SyncRequest,
     SyncChunk,
     SyncSnapshotMeta,
+    EpochTagged,
 ]
 
 
@@ -339,6 +358,8 @@ def msg_to_string(msg: ConsensusMessage) -> str:
         )
     if isinstance(msg, SyncSnapshotMeta):
         return f"<SyncSnapshotMeta height={msg.height} tip={msg.last_digest[:8]}>"
+    if isinstance(msg, EpochTagged):
+        return f"<EpochTagged epoch={msg.epoch} msg={msg_to_string(msg.msg)}>"
     return repr(msg)
 
 
@@ -359,6 +380,7 @@ __all__ = [
     "SyncRequest",
     "SyncChunk",
     "SyncSnapshotMeta",
+    "EpochTagged",
     "ConsensusMessage",
     "ProposedRecord",
     "SavedCommit",

@@ -13,8 +13,14 @@ Examples:
 
     python scripts/chaos_sweep.py --start 0 --count 200
     python scripts/chaos_sweep.py --start 0 --count 50 --window 0.05 -n 7
+    python scripts/chaos_sweep.py --start 0 --count 100 --churn
     python scripts/chaos_sweep.py --start 4000 --count 1000 \\
         --shrink-on-failure --json-out /tmp/sweep.json
+
+``--churn`` adds elastic membership to every schedule's vocabulary
+(add_node / remove_node ordered through the protocol, epoch tagging on);
+without it, schedules are byte-identical to pre-churn sweeps of the same
+seeds.
 
 Every seed runs with the observability plane sampling (read-only: ledgers
 and verdicts are identical to an unsampled run) and emits one per-seed JSON
@@ -56,7 +62,7 @@ def run_sweep(args) -> int:
     for seed in range(args.start, args.start + args.count):
         schedule = ChaosSchedule.generate(
             seed, n=args.nodes, steps=args.steps,
-            durability_window=args.window,
+            durability_window=args.window, churn=args.churn,
         )
         result = ChaosEngine(schedule, obs=obs).run()
         counts: dict[str, int] = {}
@@ -103,6 +109,7 @@ def run_sweep(args) -> int:
             "nodes": args.nodes,
             "steps": args.steps,
             "window": args.window,
+            "churn": args.churn,
         },
     }
     line = json.dumps(summary, sort_keys=True)
@@ -124,6 +131,9 @@ def main() -> int:
                     help="adversary actions per schedule")
     ap.add_argument("--window", type=float, default=0.0,
                     help="group-commit durability window (sim seconds)")
+    ap.add_argument("--churn", action="store_true",
+                    help="add elastic-membership actions (add_node / "
+                         "remove_node) to each schedule's vocabulary")
     ap.add_argument("--sample-interval", type=float, default=5.0,
                     help="obs-plane sampling interval (sim seconds)")
     ap.add_argument("--shrink-on-failure", action="store_true",

@@ -4,8 +4,13 @@ Parity model: reference test/basic_test.go (TestLeaderModifiesPreprepare:1134
 and partition scenarios) and test/reconfig_test.go (TestAddRemoveAddNodes:231).
 """
 
-from consensus_tpu.testing import Cluster, make_request
-from consensus_tpu.types import Reconfig
+from consensus_tpu.testing import (
+    Cluster,
+    boot_node,
+    install_reconfig_hook,
+    make_request,
+    reconfig_request,
+)
 from consensus_tpu.wire import Commit, PrePrepare, Prepare
 
 FAST = {
@@ -93,32 +98,8 @@ def test_lossy_network_still_orders():
 
 
 # --- dynamic reconfiguration ------------------------------------------------
-
-
-def reconfig_request(rid, nodes):
-    payload = b"nodes=" + ",".join(str(n) for n in nodes).encode()
-    return make_request("admin", rid, payload)
-
-
-def install_reconfig_hook(cluster):
-    """Make the cluster's app report membership changes: a committed request
-    with payload ``nodes=...`` yields Reconfig(in_latest_decision=True)."""
-    from consensus_tpu.testing.app import unpack_batch
-
-    def reconfig_of(proposal):
-        try:
-            requests = unpack_batch(proposal.payload)
-        except Exception:
-            return Reconfig()
-        for raw in requests:
-            _, _, payload = raw.partition(b"|")
-            if payload.startswith(b"nodes="):
-                ids = tuple(int(x) for x in payload[6:].split(b","))
-                cluster.network.membership = list(ids)
-                return Reconfig(in_latest_decision=True, current_nodes=ids)
-        return Reconfig()
-
-    cluster.reconfig_of = reconfig_of
+# reconfig_request / install_reconfig_hook / boot_node are the shared
+# harness (consensus_tpu/testing/membership.py), lifted from this file.
 
 
 def test_reconfig_removes_node_and_cluster_continues():
@@ -157,13 +138,7 @@ def test_reconfig_adds_node_which_catches_up():
     cluster.scheduler.advance(5.0)
 
     # Boot the new node; it must sync the existing ledger and participate.
-    from consensus_tpu.testing.app import Node
-    from consensus_tpu.config import Configuration
-
-    node5 = Node(5, cluster, Configuration(self_id=5, leader_rotation=False,
-                                           decisions_per_leader=0, **FAST))
-    cluster.nodes[5] = node5
-    node5.start()
+    node5 = boot_node(cluster, 5)
     cluster.scheduler.advance(120.0)  # heartbeat gap detection + sync
 
     cluster.submit_to_all(make_request("c", 9))

@@ -1,0 +1,591 @@
+"""First-class dynamic reconfiguration (PR 9): membership epochs on the
+wire, joining-node bootstrap, epoch-aware invariants, and churn chaos.
+
+Covers the full elastic-membership surface:
+
+* :mod:`consensus_tpu.membership` units — config validation, the epoch
+  timeline arithmetic (the change decision is certified by the committee it
+  retires), idempotent recording, ``ever_removed``;
+* ``EpochTagged`` wire envelope — codec round-trip, nesting rejected on
+  both the encode and decode paths;
+* the facade's epoch gate — a removed-but-live node's continued traffic is
+  dropped AND counted at every survivor, never causing an honest view
+  change, while the zombie itself is nudged into sync by the higher-epoch
+  traffic it receives and self-evicts;
+* reconfiguration learned through the SYNC path (``Controller._do_sync``'s
+  reconfig branch), not just commit-path delivery;
+* eviction of the leader with ``pipeline_depth > 1`` — in-flight slots
+  above the change are abandoned and re-proposed, no fork;
+* :class:`~consensus_tpu.membership.JoinBootstrap` — retry/backoff spacing,
+  backoff reset when the epoch advances mid-join, and the cluster-level
+  join-through-injected-unreachability scenario;
+* the seeded ``SENTINEL_STALE_MEMBERSHIP`` bug — a replica ignoring a
+  committed reconfiguration keeps the retired committee certifying, which
+  the epoch-aware invariant monitor must catch as ``epoch-cert`` and ddmin
+  must shrink to a minimal churn schedule;
+* churn chaos schedules (``generate(churn=True)``) — vocabulary gating and
+  byte-identical replay;
+* the ``membership_churn`` anomaly detector — edge-triggered firing.
+"""
+
+import struct
+
+import pytest
+
+import consensus_tpu.core.controller as controller_mod
+from consensus_tpu.config import ObsConfig
+from consensus_tpu.membership import (
+    JoinBootstrap,
+    MembershipConfig,
+    MembershipDirectory,
+)
+from consensus_tpu.metrics import (
+    MEMBERSHIP_JOIN_ATTEMPTS_KEY,
+    MEMBERSHIP_JOIN_RETRIES_KEY,
+    InMemoryProvider,
+    Metrics,
+)
+from consensus_tpu.obs.detectors import DetectorBank, DetectorThresholds
+from consensus_tpu.runtime.scheduler import SimScheduler
+from consensus_tpu.testing import (
+    Cluster,
+    install_reconfig_hook,
+    make_request,
+    reconfig_request,
+)
+from consensus_tpu.testing.chaos import (
+    CHURN_KINDS,
+    ChaosAction,
+    ChaosEngine,
+    ChaosSchedule,
+    shrink,
+)
+from consensus_tpu.testing.invariants import InvariantMonitor
+from consensus_tpu.wire import EpochTagged, HeartBeat
+from consensus_tpu.wire import codec as codec_mod
+from consensus_tpu.wire.codec import CodecError, decode_message, encode_message
+
+FAST = {
+    "request_forward_timeout": 1.0,
+    "request_complain_timeout": 4.0,
+    "request_auto_remove_timeout": 120.0,
+    "view_change_resend_interval": 2.0,
+    "view_change_timeout": 10.0,
+    "leader_heartbeat_timeout": 20.0,
+}
+
+
+@pytest.fixture
+def stale_membership_bug():
+    controller_mod.SENTINEL_STALE_MEMBERSHIP = True
+    try:
+        yield
+    finally:
+        controller_mod.SENTINEL_STALE_MEMBERSHIP = False
+
+
+def _install_metrics(cluster):
+    """Per-node InMemoryProvider metrics, installed BEFORE start so the
+    Consensus builds wire them (same move the obs sampler makes)."""
+    for node in cluster.nodes.values():
+        node.metrics = Metrics(InMemoryProvider())
+
+
+# --- membership units ------------------------------------------------------
+
+
+def test_membership_config_sorts_and_derives_quorum():
+    cfg = MembershipConfig(epoch=0, nodes=(4, 1, 3, 2))
+    assert cfg.nodes == (1, 2, 3, 4)
+    assert cfg.n == 4 and cfg.quorum == 3 and cfg.f == 1
+    assert 3 in cfg and 9 not in cfg
+    cfg.validate()
+    # Two configs with the same member set compare equal regardless of
+    # input order.
+    assert cfg == MembershipConfig(epoch=0, nodes=(1, 2, 3, 4))
+
+
+@pytest.mark.parametrize(
+    "epoch,nodes",
+    [
+        (-1, (1, 2, 3, 4)),  # negative epoch
+        (0, ()),  # empty membership
+        (0, (0, 1, 2)),  # non-positive id
+        (0, (1, 2, 2, 3)),  # duplicate id
+    ],
+)
+def test_membership_config_validate_rejects(epoch, nodes):
+    with pytest.raises(ValueError):
+        MembershipConfig(epoch=epoch, nodes=nodes).validate()
+
+
+def test_membership_directory_timeline_and_idempotence():
+    directory = MembershipDirectory([1, 2, 3, 4])
+    assert directory.current_epoch == 0
+    assert directory.membership_at(None).epoch == 0
+
+    # Grow at seq 5: the change decision itself is certified by the OLD
+    # committee, so epoch 1 takes over at seq 6.
+    grown = directory.record_change("d-grow", 5, (1, 2, 3, 4, 5))
+    assert grown.epoch == 1 and grown.nodes == (1, 2, 3, 4, 5)
+    assert directory.membership_at(5).epoch == 0
+    assert directory.membership_at(6).epoch == 1
+    assert directory.current_epoch == 1
+
+    # Idempotent: a sync replay of the same digest returns the recorded
+    # config and opens no new epoch.
+    again = directory.record_change("d-grow", 5, (1, 2, 3, 4, 5))
+    assert again is grown and directory.current_epoch == 1
+
+    shrunk = directory.record_change("d-shrink", 9, (1, 2, 3, 4))
+    assert shrunk.epoch == 2
+    assert directory.membership_at(9).epoch == 1
+    assert directory.membership_at(10).epoch == 2
+    assert directory.ever_removed() == {5}
+    assert directory.config_for_epoch(1) == grown
+    assert directory.config_for_epoch(7) is None
+
+    change = directory.changes[-1]
+    assert change.removed == (5,) and change.added == ()
+    assert "-5" in str(change)
+
+
+# --- EpochTagged wire envelope ---------------------------------------------
+
+
+def test_epoch_tagged_codec_round_trip():
+    for inner in (HeartBeat(view=3, seq=17), HeartBeat(view=0)):
+        tagged = EpochTagged(epoch=42, msg=inner)
+        decoded = decode_message(encode_message(tagged))
+        assert decoded == tagged
+        assert decoded.epoch == 42 and decoded.msg == inner
+
+
+def test_epoch_tagged_rejects_nesting_on_encode():
+    nested = EpochTagged(epoch=2, msg=EpochTagged(epoch=1, msg=HeartBeat(view=0)))
+    with pytest.raises(CodecError):
+        encode_message(nested)
+
+
+def test_epoch_tagged_rejects_nesting_on_decode():
+    # The writer refuses to produce nested bytes, so hand-frame them: an
+    # outer tag-14 envelope whose blob is ITSELF an EpochTagged encoding.
+    inner = encode_message(EpochTagged(epoch=1, msg=HeartBeat(view=0)))
+    forged = (
+        bytes([codec_mod._VERSION, codec_mod._DOMAIN_WIRE, 14])
+        + struct.pack(">Q", 2)
+        + struct.pack(">I", len(inner))
+        + inner
+    )
+    with pytest.raises(CodecError):
+        decode_message(forged)
+
+
+# --- removed-node traffic: dropped, counted, never a view change -----------
+
+
+def test_removed_node_traffic_dropped_counted_and_zombie_self_evicts():
+    """Partition node 5, evict it, heal: the zombie keeps transmitting at
+    epoch 0.  Every survivor must drop-and-count that traffic at ingress
+    (no honest view change), and the epoch-1 traffic the zombie receives
+    must nudge it into sync, where it learns its own eviction and shuts
+    down."""
+    cluster = Cluster(5, config_tweaks=dict(FAST, epoch_tagging=True))
+    install_reconfig_hook(cluster)
+    _install_metrics(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    cluster.network.partition([5])
+    cluster.submit_to_all(reconfig_request("rm5", [1, 2, 3, 4]))
+    assert cluster.run_until_ledger(2, node_ids=[1, 2, 3, 4], max_time=300.0)
+    cluster.scheduler.advance(30.0)
+
+    survivors = [1, 2, 3, 4]
+    for i in survivors:
+        assert cluster.nodes[i].consensus.membership_epoch == 1
+        assert cluster.nodes[i].metrics.membership.epoch.value == 1
+    # The zombie never learned: still serving epoch 0.
+    z = cluster.nodes[5]
+    assert z.consensus is not None and z.consensus.membership_epoch == 0
+    views_before = {
+        i: cluster.nodes[i].consensus.controller.curr_view_number
+        for i in survivors
+    }
+
+    # Inject an epoch-1 message straight into the zombie's ingress while it
+    # is still partitioned: the gate must drop-and-count it, and — because
+    # the SENDER is ahead — nudge the controller into sync.
+    nudges = []
+    orig_sync = z.consensus.controller.sync
+    z.consensus.controller.sync = lambda: (nudges.append(1), orig_sync())[0]
+    z.consensus.handle_message(1, EpochTagged(epoch=1, msg=HeartBeat(view=0)))
+    cluster.scheduler.advance(1.0)
+    z.consensus.controller.sync = orig_sync
+    assert z.metrics.membership.count_stale_epoch_dropped.value == 1
+    assert nudges, "sender-ahead stale traffic did not nudge sync"
+
+    cluster.network.heal()
+    cluster.scheduler.advance(150.0)
+
+    # The zombie's epoch-0 sends were dropped AND counted at ingress.
+    dropped = sum(
+        cluster.nodes[i].metrics.membership.count_stale_epoch_dropped.value
+        for i in survivors
+    )
+    assert dropped > 0, "survivors never counted the zombie's stale traffic"
+    # Its complaints never reached a collector: no honest view change.
+    for i in survivors:
+        assert (
+            cluster.nodes[i].consensus.controller.curr_view_number
+            == views_before[i]
+        ), f"removed node's traffic provoked a view change on {i}"
+    # The zombie caught up through sync after the heal — learned its own
+    # eviction and shut itself down.
+    assert z.consensus is None or not z.consensus._running, (
+        "zombie never learned of its eviction through the sync nudge"
+    )
+    z.running = False
+
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(3, node_ids=survivors, max_time=300.0)
+    cluster.assert_ledgers_consistent()
+
+
+# --- reconfig learned through the sync path --------------------------------
+
+
+def test_reconfig_learned_via_sync_path():
+    """Node 4 is partitioned while the rest of the cluster orders an
+    eviction (of node 5).  It must learn the reconfiguration through
+    ``Controller._do_sync``'s reconfig branch — not commit-path delivery —
+    adopt epoch 1, and participate in quorums afterwards."""
+    cluster = Cluster(5, config_tweaks=FAST)
+    install_reconfig_hook(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    cluster.network.partition([4])
+    # {1,2,3,5} is exactly the old quorum of 4 — the evictee participates
+    # in ordering its own eviction.  Submit only to the connected nodes:
+    # node 4 must never hold the admin request, or it would re-forward it
+    # after the heal and the leader would order a SECOND (idempotent but
+    # epoch-bumping) membership change.
+    for i in (1, 2, 3, 5):
+        cluster.nodes[i].submit(reconfig_request("rm5", [1, 2, 3, 4]))
+    assert cluster.run_until_ledger(2, node_ids=[1, 2, 3], max_time=300.0)
+    cluster.scheduler.advance(30.0)
+    n5 = cluster.nodes[5].consensus
+    assert n5 is None or not n5._running, "evicted node 5 did not shut down"
+    cluster.nodes[5].running = False
+
+    # The post-change committee {1,2,3,4} has quorum 3; the three connected
+    # members keep ordering while 4 is still dark.
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(3, node_ids=[1, 2, 3], max_time=300.0)
+    assert cluster.nodes[4].consensus.membership_epoch == 0
+    assert len(cluster.nodes[4].app.ledger) == 1
+
+    # Heal: node 4 detects the gap, syncs, and the LAST reconfig seen in
+    # the synced chunk surfaces through _do_sync's reconfig branch.
+    cluster.network.heal()
+    cluster.scheduler.advance(150.0)
+    assert cluster.nodes[4].consensus.membership_epoch == 1, (
+        "sync-learned reconfiguration was not applied"
+    )
+    assert len(cluster.nodes[4].app.ledger) >= 3
+
+    # Node 4 must now COUNT: crash node 3, so the epoch-1 quorum (3 of
+    # {1,2,3,4}) cannot form without node 4.
+    cluster.nodes[3].crash()
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(4, node_ids=[1, 2, 4], max_time=600.0), (
+        "sync-joined node 4 did not participate in the post-change quorum"
+    )
+    cluster.assert_ledgers_consistent()
+
+
+# --- evicting the leader under pipelining ----------------------------------
+
+
+def test_remove_leader_with_pipelined_slots():
+    """Evict the CURRENT LEADER while ``pipeline_depth=3`` keeps multiple
+    slots in flight: slots above the change decision are abandoned at the
+    rebuild (their pool reservations released) and re-proposed under the
+    new epoch — every submitted request still commits exactly once, no
+    fork."""
+    cluster = Cluster(5, config_tweaks=dict(FAST, pipeline_depth=3))
+    install_reconfig_hook(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    # Fill the pipeline and slip the eviction of leader 1 into the stream.
+    for i in range(1, 4):
+        cluster.submit_to_all(make_request("c", i))
+    cluster.submit_to_all(reconfig_request("rm1", [2, 3, 4, 5]))
+    for i in range(4, 7):
+        cluster.submit_to_all(make_request("c", i))
+
+    survivors = [2, 3, 4, 5]
+    # Everything submitted must eventually commit on the survivors: 1
+    # warmup + 6 payloads + the reconfig = 8 decisions (batching may pack
+    # several requests per decision, so require the REQUESTS, not a height).
+    def all_committed():
+        for i in survivors:
+            payloads = b"|".join(
+                d.proposal.payload for d in cluster.nodes[i].app.ledger
+            )
+            if not all(
+                make_request("c", k) in payloads for k in range(7)
+            ):
+                return False
+        return True
+
+    assert cluster.scheduler.run_until(all_committed, max_time=900.0), (
+        "requests in flight across the eviction were lost"
+    )
+    cluster.scheduler.advance(30.0)
+    n1 = cluster.nodes[1].consensus
+    assert n1 is None or not n1._running, "evicted ex-leader did not shut down"
+    cluster.nodes[1].running = False
+    for i in survivors:
+        assert cluster.nodes[i].consensus.membership_epoch == 1
+
+    # The rebuilt pool must accept and order NEW work (reservations from
+    # the abandoned slots were released, not leaked).
+    cluster.submit_to_all(make_request("d", 0))
+    target = len(cluster.nodes[2].app.ledger) + 1
+    assert cluster.run_until_ledger(target, node_ids=survivors, max_time=600.0)
+    cluster.assert_ledgers_consistent()
+    # No request committed twice.
+    for i in survivors:
+        digests = [d.proposal.digest() for d in cluster.nodes[i].app.ledger]
+        assert len(digests) == len(set(digests))
+
+
+# --- JoinBootstrap: retry / backoff ----------------------------------------
+
+
+def test_join_bootstrap_backoff_spacing_and_epoch_reset():
+    sched = SimScheduler()
+    attempts_at = []
+    state = {"done": False, "epoch": 0}
+    provider = InMemoryProvider()
+    metrics = Metrics(provider)
+    jb = JoinBootstrap(
+        sched,
+        sync=lambda: attempts_at.append(sched.now()),
+        caught_up=lambda: state["done"],
+        current_epoch=lambda: state["epoch"],
+        metrics=metrics.membership,
+        initial_delay=2.0,
+        max_delay=16.0,
+        backoff=2.0,
+    )
+    jb.start()
+    # Exponential spacing: attempts at 0, +2, +4, +8 ...
+    sched.advance(13.0)
+    assert attempts_at == [0.0, 2.0, 6.0]
+    assert jb.attempts == 3 and jb.retries == 2
+
+    # The membership epoch advances mid-join: the delay resets to the
+    # initial value at the NEXT attempt (t=14), so the one after comes at
+    # t=16 instead of t=30.
+    state["epoch"] = 1
+    sched.advance(4.0)  # t=17
+    assert attempts_at == [0.0, 2.0, 6.0, 14.0, 16.0]
+
+    # Catching up finishes the driver without another counted attempt.
+    state["done"] = True
+    sched.advance(10.0)
+    assert jb.done
+    assert jb.attempts == 5 and jb.retries == 4
+    assert provider.value(MEMBERSHIP_JOIN_ATTEMPTS_KEY) == 5
+    assert provider.value(MEMBERSHIP_JOIN_RETRIES_KEY) == 4
+
+
+def test_join_bootstrap_stop_cancels_future_attempts():
+    sched = SimScheduler()
+    calls = []
+    jb = JoinBootstrap(
+        sched, sync=lambda: calls.append(sched.now()), caught_up=lambda: False
+    )
+    jb.start()
+    sched.advance(0.5)
+    assert len(calls) == 1
+    jb.stop()
+    sched.advance(600.0)
+    assert len(calls) == 1 and jb.done
+
+
+def test_added_node_bootstraps_through_injected_unreachability():
+    """The DSL-visible join scenario from the acceptance bar: a node
+    admitted by a grow decision boots while UNREACHABLE, keeps re-probing
+    on backoff (counted into the pinned join metrics), and completes the
+    wire sync promptly once the network heals — then counts in quorums."""
+    cluster = Cluster(4, config_tweaks=FAST, obs=ObsConfig(enabled=True))
+    install_reconfig_hook(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+
+    cluster.submit_to_all(reconfig_request("add5", [1, 2, 3, 4, 5]))
+    assert cluster.run_until_ledger(2, node_ids=[1, 2, 3, 4], max_time=300.0)
+    cluster.scheduler.advance(5.0)
+
+    # Admit node 5 behind a partition: every sync probe fails.
+    cluster.network.partition([5])
+    node5 = cluster.add_node(5)
+    jb = node5.join_bootstrap
+    assert jb is not None
+    cluster.scheduler.advance(30.0)
+    assert not jb.done
+    assert jb.attempts >= 3 and jb.retries >= 2, (
+        f"join did not keep retrying under unreachability: {jb.attempts}"
+    )
+    assert len(node5.app.ledger) == 0
+
+    # Heal: the next backoff probe syncs the chain and the reconfig lifts
+    # the joiner to the current epoch.
+    cluster.network.heal()
+    assert cluster.scheduler.run_until(lambda: jb.done, max_time=120.0), (
+        "join bootstrap never completed after the heal"
+    )
+    assert node5.consensus.membership_epoch == 1
+    assert len(node5.app.ledger) >= 2
+    assert (
+        node5.metrics.membership.count_join_attempts.value == jb.attempts
+    )
+    assert node5.metrics.membership.count_join_retries.value == jb.retries >= 2
+
+    # Joined quorums for real: with node 4 down, epoch-1 quorum (4 of 5)
+    # cannot form without node 5.
+    cluster.nodes[4].crash()
+    cluster.submit_to_all(make_request("c", 1))
+    target = len(cluster.nodes[1].app.ledger) + 1
+    assert cluster.run_until_ledger(
+        target, node_ids=[1, 2, 3, 5], max_time=600.0
+    ), "joiner did not participate in the post-join quorum"
+    cluster.assert_ledgers_consistent()
+
+
+# --- the seeded sentinel: stale membership ---------------------------------
+
+
+def test_sentinel_stale_membership_caught_as_epoch_cert(stale_membership_bug):
+    """With the sentinel armed every replica IGNORES the eviction decision:
+    the retired committee keeps certifying.  Crashing node 4 first forces
+    every later cert to include evicted node 5 — the epoch-aware monitor
+    must flag those certs as ``epoch-cert`` violations naming the evictee."""
+    cluster = Cluster(5, config_tweaks=FAST)
+    install_reconfig_hook(cluster)
+    monitor = InvariantMonitor(cluster)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1)
+    assert not monitor.violations
+
+    # Down node 4: any further quorum (4 of 5) must include node 5.
+    cluster.nodes[4].crash()
+    cluster.submit_to_all(reconfig_request("rm5", [1, 2, 3, 4]))
+    alive = [1, 2, 3, 5]
+    assert cluster.run_until_ledger(2, node_ids=alive, max_time=300.0)
+    # The change decision itself is certified by the OLD committee — legal.
+    assert cluster.membership_directory.current_epoch == 1
+    assert not monitor.violations
+
+    # The bug: nobody rebuilt, node 5 keeps signing.  The next decision is
+    # certified above the change by a retired committee.
+    cluster.submit_to_all(make_request("c", 1))
+    assert cluster.run_until_ledger(3, node_ids=alive, max_time=300.0)
+    assert monitor.first is not None
+    assert monitor.first.invariant == "epoch-cert"
+    assert "previously removed: [5]" in monitor.first.detail
+    with pytest.raises(Exception):
+        monitor.assert_clean()
+
+
+def test_sentinel_shrinks_to_minimal_churn_repro(stale_membership_bug):
+    """A churn chaos schedule seeded with the stale-membership bug fails
+    with ``epoch-cert``; ddmin must converge to a minimal reproducer that
+    still contains the essential ``remove_node`` action."""
+    schedule = ChaosSchedule(
+        seed=0,
+        n=5,
+        actions=(
+            ChaosAction(at=30.0, kind="crash", args={"node": 4}),
+            ChaosAction(at=60.0, kind="remove_node", args={"node": 5}),
+        ),
+    )
+    small, result = shrink(schedule, invariant="epoch-cert", max_runs=20)
+    assert result.violation is not None
+    assert result.violation.invariant == "epoch-cert"
+    kinds = [a.kind for a in small.actions]
+    assert "remove_node" in kinds
+    assert len(small.actions) <= 2
+
+
+# --- churn chaos schedules -------------------------------------------------
+
+
+def test_generate_without_churn_has_no_churn_vocabulary():
+    for seed in range(10):
+        schedule = ChaosSchedule.generate(seed, n=4, steps=12)
+        assert not any(a.kind in CHURN_KINDS for a in schedule.actions)
+
+
+def test_churn_chaos_run_is_deterministic_and_clean():
+    schedule = ChaosSchedule.generate(2, n=4, steps=12, churn=True)
+    assert any(a.kind in CHURN_KINDS for a in schedule.actions), (
+        "pinned seed 2 no longer draws churn actions — pick another seed"
+    )
+    results = [ChaosEngine(schedule).run() for _ in range(2)]
+    assert results[0].ok, results[0].violation
+    assert results[0].event_log == results[1].event_log, (
+        "churn chaos run diverged across replays"
+    )
+    assert results[0].ledgers == results[1].ledgers
+
+
+# --- membership_churn anomaly detector -------------------------------------
+
+
+def test_membership_churn_detector_fires_in_churn_chaos_run():
+    """End-to-end: two membership changes inside the churn window, observed
+    through the sampler's health snapshots, fire the detector on the
+    surviving members."""
+    schedule = ChaosSchedule(
+        seed=5,
+        n=4,
+        actions=(
+            ChaosAction(at=40.0, kind="add_node", args={"node": 5}),
+            ChaosAction(at=120.0, kind="remove_node", args={"node": 5}),
+        ),
+    )
+    engine = ChaosEngine(schedule, obs=ObsConfig(enabled=True))
+    result = engine.run()
+    assert result.ok, result.violation
+    counts = engine.cluster.sampler.anomaly_counts()
+    assert counts.get("membership_churn", 0) >= 1, counts
+
+
+def test_membership_churn_detector_fires_edge_triggered():
+    bank = DetectorBank(DetectorThresholds(churn_epochs=2, churn_window=100.0))
+
+    def ev(t, epoch):
+        return bank.evaluate(t, {1: {"running": True, "epoch": epoch}})
+
+    assert ev(0.0, 0) == []
+    assert ev(10.0, 1) == []  # one change: below threshold
+    fired = ev(20.0, 2)  # second change inside the window
+    assert [a.kind for a in fired] == ["membership_churn"]
+    assert fired[0].node == 1 and "epoch" in fired[0].detail
+    # Latched while the condition holds: no re-fire.
+    assert ev(30.0, 2) == []
+    # Window expires -> latch clears -> a fresh burst fires again.
+    assert ev(140.0, 2) == []
+    assert ev(150.0, 3) == []
+    assert [a.kind for a in ev(160.0, 4)] == ["membership_churn"]

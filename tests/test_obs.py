@@ -194,9 +194,12 @@ def test_leader_churn_schedule_fires_storm_and_flap_detectors():
     assert result.ok, result.violation
     counts = engine.cluster.sampler.anomaly_counts()
     assert {"view_change_storm", "leader_flap"} <= set(counts)
-    # Together with the partition schedule, the full detector matrix fires.
+    # Together with the partition schedule and the churn chaos run
+    # (tests/test_membership.py fires membership_churn end-to-end), the
+    # full detector matrix fires.
     partition_kinds = {"commit_stall", "sync_lag", "verify_collapse"}
-    assert partition_kinds | set(counts) >= set(ANOMALY_KINDS)
+    churn_kinds = {"membership_churn"}
+    assert partition_kinds | churn_kinds | set(counts) >= set(ANOMALY_KINDS)
 
 
 def test_detector_firings_are_deterministic():

@@ -98,8 +98,8 @@ def test_add_node_after_many_rotations():
     # (reconfig_test.go:556) — rotate the leadership through many decisions,
     # then reconfigure to add a node; the joiner syncs and the grown cluster
     # keeps ordering under rotation.
-    from tests.test_scenarios_reconfig_vc import (
-        _boot_node,
+    from consensus_tpu.testing import (
+        boot_node,
         install_reconfig_hook,
         reconfig_request,
     )
@@ -118,7 +118,7 @@ def test_add_node_after_many_rotations():
     # Reconfigure to add node 5.
     cluster.submit_to_all(reconfig_request(100, [1, 2, 3, 4, 5]))
     assert cluster.run_until_ledger(9, max_time=600.0)
-    _boot_node(cluster, 5)
+    boot_node(cluster, 5)
 
     # The grown cluster keeps rotating and ordering; the joiner catches up.
     for i in range(10, 14):

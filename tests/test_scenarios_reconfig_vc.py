@@ -7,8 +7,13 @@ restart-during-view-change family.  Each scenario asserts both safety
 the fault heals).
 """
 
-from consensus_tpu.testing import Cluster, make_request
-from consensus_tpu.types import Reconfig
+from consensus_tpu.testing import (
+    Cluster,
+    boot_node as _boot_node,
+    install_reconfig_hook,
+    make_request,
+    reconfig_request,
+)
 from consensus_tpu.wire import NewView
 
 FAST = {
@@ -20,46 +25,8 @@ FAST = {
     "leader_heartbeat_timeout": 20.0,
 }
 
-
-def reconfig_request(rid, nodes):
-    payload = b"nodes=" + ",".join(str(n) for n in nodes).encode()
-    return make_request("admin", rid, payload)
-
-
-def install_reconfig_hook(cluster):
-    """A committed request with payload ``nodes=...`` changes membership."""
-    from consensus_tpu.testing.app import unpack_batch
-
-    def reconfig_of(proposal):
-        try:
-            requests = unpack_batch(proposal.payload)
-        except Exception:
-            return Reconfig()
-        for raw in requests:
-            _, _, payload = raw.partition(b"|")
-            if payload.startswith(b"nodes="):
-                ids = tuple(int(x) for x in payload[6:].split(b","))
-                cluster.network.membership = list(ids)
-                return Reconfig(in_latest_decision=True, current_nodes=ids)
-        return Reconfig()
-
-    cluster.reconfig_of = reconfig_of
-
-
-def _boot_node(cluster, node_id):
-    from consensus_tpu.config import Configuration
-    from consensus_tpu.testing.app import Node
-
-    node = Node(
-        node_id,
-        cluster,
-        Configuration(
-            self_id=node_id, leader_rotation=False, decisions_per_leader=0, **FAST
-        ),
-    )
-    cluster.nodes[node_id] = node
-    node.start()
-    return node
+# reconfig_request / install_reconfig_hook / _boot_node used to be defined
+# here; they are now the shared harness (consensus_tpu/testing/membership.py).
 
 
 def test_reconfig_submitted_during_view_change():
