@@ -255,10 +255,91 @@ def bench_p256(msgs, sigs, keys) -> tuple[float, float]:
     return device_rate, host_rate
 
 
+#: Half-aggregated quorum-cert family: quorum size and timed verifies per
+#: rate sample.  16 matches the acceptance bar the cert-byte ratio is
+#: pinned at (ISSUE 10 / SAFETY.md §9).
+CERT_QUORUM = 16
+CERT_ITERS = 32
+
+
+def make_cert_quorum(n: int = CERT_QUORUM):
+    """A quorum-sized commit-signature set: n distinct signers, one message
+    each.  Uses the in-repo reference implementation so the family runs
+    (and skips) without the ``cryptography`` package."""
+    from consensus_tpu.models.ed25519 import ref_public_key, ref_sign
+
+    msgs, sigs, keys = [], [], []
+    for i in range(n):
+        seed = bytes([i + 1]) * 32
+        m = b"ctpu/bench-cert/%d" % i
+        msgs.append(m)
+        sigs.append(ref_sign(seed, m))
+        keys.append(ref_public_key(seed))
+    return msgs, sigs, keys
+
+
+def bench_cert_verify() -> tuple[float, float, dict]:
+    """(device aggregate-verify rate, host-twin rate, cert-byte record) for
+    half-aggregated quorum certs (models/aggregate.py).  Rates count
+    component signatures vouched per second — one cert vouches for all n
+    signers in ONE MSM launch on the device path; the baseline is the pure
+    big-int host twin of the same aggregate equation."""
+    from consensus_tpu.models.aggregate import HalfAggregator
+    from consensus_tpu.types import QuorumCert, Signature
+    from consensus_tpu.wire.codec import encoded_cert_size
+
+    msgs, sigs, keys = make_cert_quorum()
+    n = len(msgs)
+    device = HalfAggregator(min_device_batch=1)
+    host = HalfAggregator(min_device_batch=10**9)
+    agg, bad = device.aggregate(msgs, sigs, keys)
+    assert agg is not None and not bad, "benchmark quorum must aggregate"
+    rs, s_agg = agg
+    assert device.verify(msgs, list(rs), s_agg, keys)  # warmup: compiles
+
+    def rate(aggregator) -> float:
+        start = time.perf_counter()
+        for _ in range(CERT_ITERS):
+            assert aggregator.verify(msgs, list(rs), s_agg, keys)
+        return CERT_ITERS * n / (time.perf_counter() - start)
+
+    device_rate = rate(device)
+    host_rate = rate(host)
+
+    # Byte accounting with the aux payload the protocol actually rides on
+    # commit signatures (the prepare-sender voter list) — identical across
+    # signers, so the cert's aux_table dedups it to ONE entry.
+    from consensus_tpu.wire.codec import encode_prepares_from
+    from consensus_tpu.wire.messages import PreparesFrom
+
+    aux = encode_prepares_from(PreparesFrom(ids=tuple(range(1, n + 1))))
+    full = tuple(
+        Signature(id=i + 1, value=sigs[i], msg=aux) for i in range(n)
+    )
+    half = QuorumCert(
+        signer_ids=tuple(range(1, n + 1)),
+        rs=tuple(rs),
+        s_agg=s_agg,
+        aux_table=(aux,),
+        aux_index=(0,) * n,
+    )
+    full_bytes = encoded_cert_size(full)
+    half_bytes = encoded_cert_size(half)
+    return device_rate, host_rate, {
+        "quorum": n,
+        "full_bytes": full_bytes,
+        "half_agg_bytes": half_bytes,
+        "ratio": round(half_bytes / full_bytes, 3),
+    }
+
+
 #: Subprocess body for the structured-skip kernel-accounting probe: a tiny
 #: Ed25519 batch on the CPU backend, run twice so launches exceed compiles,
 #: printing the obs kernel registry as one JSON line.  Host-side compile /
 #: retrace trajectory stays observable even when the device is unreachable.
+#: (The ``ed25519.halfagg_verify`` kernel shares this body and would cost
+#: the probe a second compile, so its trajectory is only recorded on live
+#: ``cert_verify`` runs.)
 _KERNEL_PROBE_CODE = """\
 import json
 from consensus_tpu.models import Ed25519Signer
@@ -390,11 +471,11 @@ def main() -> None:
     from __graft_entry__ import _enable_compile_cache
 
     _enable_compile_cache()
-    metric = (
-        "ecdsa_p256_verify_throughput"
-        if len(sys.argv) > 1 and sys.argv[1] == "p256"
-        else "ed25519_verify_throughput"
-    )
+    family = sys.argv[1] if len(sys.argv) > 1 else "ed25519"
+    metric = {
+        "p256": "ecdsa_p256_verify_throughput",
+        "cert_verify": "cert_verify_throughput",
+    }.get(family, "ed25519_verify_throughput")
     if os.environ.get("CTPU_PALLAS_SCAN") == "1":
         # The experimental Pallas-scheduled run reports (and trails) under
         # its own key — it must never overwrite the headline last-good
@@ -437,7 +518,10 @@ def main() -> None:
     backend = jax.default_backend()
     batch_verify_rate = None
     mesh_record = None
-    if metric == "ecdsa_p256_verify_throughput":
+    cert_bytes_record = None
+    if metric == "cert_verify_throughput":
+        device_rate, host_rate, cert_bytes_record = bench_cert_verify()
+    elif metric == "ecdsa_p256_verify_throughput":
         msgs, sigs, keys = make_p256_signatures(BATCH)
         device_rate, host_rate = bench_p256(msgs, sigs, keys)
     else:
@@ -472,6 +556,8 @@ def main() -> None:
         }
     if mesh_record is not None:
         record["mesh_verify"] = mesh_record
+    if cert_bytes_record is not None:
+        record["cert_bytes"] = cert_bytes_record
     from consensus_tpu.obs.kernels import KERNELS
 
     record["kernels"] = _kernel_accounting("live", KERNELS.snapshot())

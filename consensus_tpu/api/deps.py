@@ -20,6 +20,7 @@ from typing import Optional, Sequence
 from consensus_tpu.types import (
     Decision,
     Proposal,
+    QuorumCert,
     Reconfig,
     RequestInfo,
     Signature,
@@ -93,6 +94,16 @@ class Signer(abc.ABC):
     @abc.abstractmethod
     def sign_proposal(self, proposal: Proposal, aux: bytes = b"") -> Signature: ...
 
+    def aggregate_cert(
+        self, proposal: Proposal, signatures: Sequence[Signature]
+    ) -> Optional[QuorumCert]:
+        """Optionally compress a full commit-signature quorum into a
+        half-aggregated :class:`~consensus_tpu.types.QuorumCert`
+        (cert_mode="half-agg").  Default returns None — aggregation
+        unsupported, the core keeps the full signature tuple, so
+        third-party signers are unaffected."""
+        return None
+
 
 class Verifier(abc.ABC):
     """Validation of requests, proposals, and signatures.
@@ -156,6 +167,31 @@ class Verifier(abc.ABC):
     #: into per-group launches and re-pay the doubling chain per group.
     multi_batch_delegate: Optional["Verifier"] = None
 
+    #: True when this verifier can assemble AND check half-aggregated
+    #: quorum certs (Configuration.cert_mode="half-agg").  Third-party
+    #: verifiers keep the False default: the core then never aggregates
+    #: and full signature tuples flow exactly as before.
+    supports_cert_aggregation: bool = False
+
+    def aggregate_cert(
+        self, proposal: Proposal, signatures: Sequence[Signature]
+    ) -> Optional[QuorumCert]:
+        """Compress a verified commit-signature quorum over ``proposal``
+        into a half-aggregated cert, or return None when aggregation is
+        unsupported/fails (the caller keeps the full tuple — graceful
+        fallback, never an error)."""
+        return None
+
+    def verify_aggregate_cert(
+        self, cert: QuorumCert, proposal: Proposal
+    ) -> Optional[list[bytes]]:
+        """Verify a half-aggregated quorum cert over ``proposal`` in one
+        aggregate check; returns the per-component auxiliary payloads on
+        success, or None when the cert is invalid or this verifier cannot
+        check aggregates (default — a full-mode replica REJECTS compact
+        certs rather than crashing on them)."""
+        return None
+
     def verify_requests_batch(self, raw_requests: Sequence[bytes]) -> list[Optional[RequestInfo]]:
         """Verify many requests; element is None where verification failed.
 
@@ -176,7 +212,16 @@ class Verifier(abc.ABC):
         auxiliary payload, or None where verification failed.
 
         Default loops over ``verify_consenter_sig``; TPU verifiers override.
+        A half-aggregated :class:`QuorumCert` routes through
+        ``verify_aggregate_cert`` instead — all-or-nothing, so a failed
+        aggregate rejects every component (the engine's bisection, where
+        available, localizes the culprit before results reach here).
         """
+        if isinstance(signatures, QuorumCert):
+            aux = self.verify_aggregate_cert(signatures, proposal)
+            if aux is None:
+                return [None] * len(signatures)
+            return list(aux)
         out: list[Optional[bytes]] = []
         for sig in signatures:
             try:
@@ -199,7 +244,23 @@ class Verifier(abc.ABC):
         the default instead forwards the whole group list to the delegate's
         coalescing implementation — one launch for all groups, with the
         engine's bisection localizing any failing group on its own.
+
+        Groups must be cert-mode homogeneous: mixing half-aggregated
+        QuorumCerts with full signature tuples in one call raises
+        ValueError (contradiction guard, mirroring the batch_verify_mode
+        all-replicas-agree rule) — a mixed chunk means the peers disagree
+        on cert_mode and silently splitting it would mask that.  Callers
+        spanning a cert_mode flip (sync catch-up across a membership epoch
+        boundary) partition into homogeneous calls first.
         """
+        if groups:
+            kinds = {isinstance(sigs, QuorumCert) for _, sigs in groups}
+            if len(kinds) > 1:
+                raise ValueError(
+                    "verify_consenter_sigs_multi_batch: groups mix "
+                    "half-aggregated QuorumCerts with full signature tuples "
+                    "— cert modes contradict; partition the groups first"
+                )
         delegate = self.multi_batch_delegate
         if self.batch_verify_enabled and delegate is not None:
             return delegate.verify_consenter_sigs_multi_batch(groups)

@@ -132,6 +132,15 @@ class Configuration:
     # torsion-component signatures can differ from the strict kernel's
     # (SAFETY.md §7).
     batch_verify_mode: bool = False
+    # Quorum-certificate encoding (models/aggregate.py).  "full" keeps the
+    # seed's n-full-signature certs bit-for-bit; "half-agg" assembles
+    # half-aggregated Ed25519 certs — (R₁..Rₙ, s_agg), ~32n+32 bytes
+    # instead of ~64n — on the wire, in the WAL, in view-change proofs,
+    # and in sync chunks, verified in ONE MSM launch.  All replicas in a
+    # cluster must agree on this flag (a half-agg cert is not verifiable
+    # by a full-mode replica's strict path and vice versa — the
+    # multi-batch contradiction guard fails loud on mixed groups).
+    cert_mode: str = "full"
     # Device-mesh width for the batch engine (parallel/sharding.py): 1 keeps
     # today's single-device engines bit-for-bit; >1 selects the sharded
     # engines (shard_map over a 1-D mesh, batch axis partitioned, validity
@@ -211,6 +220,8 @@ class Configuration:
             errs.append("pipeline_depth must be >= 1")
         if self.mesh_shards < 1:
             errs.append("mesh_shards must be >= 1")
+        if self.cert_mode not in ("full", "half-agg"):
+            errs.append('cert_mode must be "full" or "half-agg"')
         if self.crypto_tpu_min_batch < 1:
             errs.append("crypto_tpu_min_batch must be >= 1")
         if self.pipeline_depth > 1 and self.leader_rotation:

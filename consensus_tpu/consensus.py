@@ -373,6 +373,7 @@ class Consensus:
             decisions_per_leader=cfg.decisions_per_leader,
             on_reconfig=self._on_reconfig,
             metrics=self.metrics.view_change,
+            cert_mode=cfg.cert_mode,
         )
         self.controller.view_changer = self.view_changer
 
@@ -408,6 +409,7 @@ class Consensus:
             pipeline_depth=self.config.pipeline_depth,
             consensus_metrics=self.metrics.consensus,
             tracer=self.tracer,
+            cert_mode=self.config.cert_mode,
         )
 
     def _start_components(self, view: int, seq: int, dec: int) -> None:

@@ -340,7 +340,10 @@ class PersistedState:
         if idx < 0:
             return None
         rec = decode_saved(self.entries[idx])
-        if isinstance(rec, SavedCommit) and idx >= 1:
+        # Walk back over the SavedCommit run: under cert_mode="half-agg" the
+        # endorsement commit may be followed by its cert-bearing twin at the
+        # same (view, seq) — both truncate-free appends, both ours.
+        while isinstance(rec, SavedCommit) and idx >= 1:
             idx -= 1
             rec = decode_saved(self.entries[idx])
         if isinstance(rec, ProposedRecord) and idx >= 1:
@@ -554,7 +557,19 @@ class PersistedState:
         commit = record.commit
         if len(self.entries) < 2:
             raise ValueError("commit record without a preceding pre-prepare")
-        prev = decode_saved(self.entries[-2])
+        # Under cert_mode="half-agg" the decide path appends a cert-bearing
+        # SavedCommit twin after the endorsement commit — walk back over any
+        # same-(view, seq) SavedCommit run to the anchoring ProposedRecord.
+        idx = len(self.entries) - 2
+        prev = decode_saved(self.entries[idx])
+        while (
+            isinstance(prev, SavedCommit)
+            and prev.commit.view == commit.view
+            and prev.commit.seq == commit.seq
+            and idx >= 1
+        ):
+            idx -= 1
+            prev = decode_saved(self.entries[idx])
         if not isinstance(prev, ProposedRecord):
             raise ValueError(
                 f"expected ProposedRecord before commit, got {type(prev).__name__}"

@@ -32,7 +32,7 @@ from consensus_tpu.api.deps import MembershipNotifier, Signer, Verifier
 from consensus_tpu.metrics import MetricsConsensus, MetricsView, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler
 from consensus_tpu.trace.tracer import NOOP_TRACER
-from consensus_tpu.types import Proposal, RequestInfo, Signature
+from consensus_tpu.types import Proposal, QuorumCert, RequestInfo, Signature, as_cert
 from consensus_tpu.utils.digests import commit_signatures_digest
 from consensus_tpu.utils.blacklist import compute_blacklist_update
 from consensus_tpu.utils.quorum import compute_quorum
@@ -49,6 +49,7 @@ from consensus_tpu.wire import (
     decode_view_metadata,
     encode_prepares_from,
     encode_view_metadata,
+    encoded_cert_size,
     msg_to_string,
 )
 
@@ -178,6 +179,7 @@ class View:
         pipeline_depth: int = 1,
         consensus_metrics: Optional[MetricsConsensus] = None,
         tracer=None,
+        cert_mode: str = "full",
     ) -> None:
         self._sched = scheduler
         self.self_id = self_id
@@ -213,6 +215,11 @@ class View:
         self.pipeline_depth = max(1, pipeline_depth)
         self._future: dict[int, _FutureSlot] = {}
         self._consensus_metrics = consensus_metrics
+        #: Configuration.cert_mode — "half-agg" compresses each decided
+        #: quorum into a half-aggregated QuorumCert (models/aggregate.py)
+        #: when the verifier supports it; "full" keeps signature tuples
+        #: bit-for-bit.
+        self.cert_mode = cert_mode
 
         # Pipelining buffers: current sequence + the next one (depth 1),
         # parity: reference view.go:107-113,860-894.
@@ -326,12 +333,15 @@ class View:
         and `_verify_prev_commit_signatures` accepts an empty set)."""
         pipelined = self.effective_depth > 1
         _, prev_sigs = self._checkpoint.get()
+        prev_cert = () if pipelined else as_cert(prev_sigs)
         pp = PrePrepare(
             view=self.number,
             seq=self.next_propose_seq if pipelined else self.proposal_sequence,
             proposal=proposal,
-            prev_commit_signatures=() if pipelined else tuple(prev_sigs),
+            prev_commit_signatures=prev_cert,
         )
+        if isinstance(prev_cert, QuorumCert) and self._consensus_metrics is not None:
+            self._consensus_metrics.net_cert_bytes.add(encoded_cert_size(prev_cert))
         self.handle_message(self.leader_id, pp)
 
     def abort(self) -> None:
@@ -619,6 +629,15 @@ class View:
         proposal = pp.proposal
         i_am_leader = self.self_id == self.leader_id
         tracer = self._tracer
+        if (
+            isinstance(pp.prev_commit_signatures, QuorumCert)
+            and self._consensus_metrics is not None
+        ):
+            # Every replica WALs this pre-prepare exactly once (leader before
+            # verification, follower after); account the cert's share here.
+            self._consensus_metrics.wal_cert_bytes.add(
+                encoded_cert_size(pp.prev_commit_signatures)
+            )
         if tracer.enabled:
             tracer.begin(
                 "view", "decision", seq=self.proposal_sequence, view=self.number
@@ -897,8 +916,71 @@ class View:
                 view=self.number,
                 commits=len(signatures),
             )
+        decided_sigs = self._maybe_aggregate_cert(proposal, signatures)
         self._start_next_seq()
-        self._decider.decide(proposal, signatures, requests)
+        self._decider.decide(proposal, decided_sigs, requests)
+
+    def _maybe_aggregate_cert(self, proposal: Proposal, signatures: list[Signature]):
+        """Half-aggregate the decided quorum into a compact ``QuorumCert``.
+
+        Active only under ``cert_mode="half-agg"`` with an aggregation-capable
+        verifier; otherwise the full signature list flows through untouched
+        (bit-for-bit identical to the pre-cert behaviour).  Aggregation
+        failure — a component signature the aggregator's self-check rejects,
+        localized by bisection — degrades gracefully back to the full tuple:
+        compactness is a perf optimisation, never a liveness dependency.
+
+        On success the cert is persisted alongside the already-WAL'd commit
+        (a second SavedCommit twin at the same (view, seq); recovery scans
+        tolerate the duplicate and prefer the cert-bearing record), so a
+        restarted leader can re-serve the compact cert without re-running
+        aggregation over signatures it no longer holds.
+        """
+        if self.cert_mode != "half-agg":
+            return signatures
+        aggregate = getattr(self._verifier, "aggregate_cert", None)
+        if aggregate is None or not getattr(
+            self._verifier, "supports_cert_aggregation", False
+        ):
+            return signatures
+        cm = self._consensus_metrics
+        if self._tracer.enabled:
+            self._tracer.begin(
+                "view", "cert.aggregate", seq=self.proposal_sequence, view=self.number
+            )
+        cert = None
+        try:
+            cert = aggregate(proposal, tuple(signatures))
+        finally:
+            if self._tracer.enabled:
+                self._tracer.end(
+                    "view",
+                    "cert.aggregate",
+                    seq=self.proposal_sequence,
+                    view=self.number,
+                    aggregated=cert is not None,
+                )
+        if cert is None:
+            logger.warning(
+                "%d: cert aggregation fell back to full signatures at seq %d",
+                self.self_id, self.proposal_sequence,
+            )
+            if cm is not None:
+                cm.cert_fallback_bisections.add(1)
+            return signatures
+        if cm is not None:
+            nbytes = encoded_cert_size(cert)
+            cm.cert_aggregate_launches.add(1)
+            cm.cert_bytes_per_cert.observe(nbytes)
+            cm.wal_cert_bytes.add(nbytes)
+        if self._curr_commit_sent is not None:
+            self._state.save(
+                SavedCommit(
+                    commit=dataclasses.replace(self._curr_commit_sent, assist=False),
+                    cert=cert,
+                )
+            )
+        return cert
 
     def _batch_verify_pending_commits(self, needed: int) -> None:
         """Verify buffered commit votes in one batch call (the TPU seam).
@@ -1110,6 +1192,13 @@ class View:
         requests, cert_results = self._verifier.verify_proposal_and_prev_commits(
             proposal, prev_commits if certs_apply else (), prev_proposal
         )
+        if certs_apply and isinstance(prev_commits, QuorumCert):
+            # Follower-side accounting of the leader's compact cert: one
+            # aggregate-verify launch, and the cert's wire footprint.
+            cm = self._consensus_metrics
+            if cm is not None:
+                cm.cert_aggregate_launches.add(1)
+                cm.cert_bytes_per_cert.observe(encoded_cert_size(prev_commits))
 
         md = decode_view_metadata(proposal.metadata)
         if md.view_id != self.number:

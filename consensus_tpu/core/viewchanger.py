@@ -45,7 +45,14 @@ from consensus_tpu.core.state import (
 from consensus_tpu.core.view import Phase, View
 from consensus_tpu.metrics import MetricsViewChange, NoopProvider
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
-from consensus_tpu.types import Checkpoint, Proposal, RequestInfo, Signature
+from consensus_tpu.types import (
+    Checkpoint,
+    Proposal,
+    QuorumCert,
+    RequestInfo,
+    Signature,
+    as_cert,
+)
 from consensus_tpu.utils.leader import get_leader_id
 from consensus_tpu.utils.quorum import compute_quorum
 from consensus_tpu.wire import (
@@ -119,6 +126,21 @@ def validate_last_decision(
         raise ValueError(
             f"last decision view {md.view_id} >= requested next view {vd.next_view}"
         )
+    if isinstance(vd.last_decision_signatures, QuorumCert):
+        # Half-aggregated proof: signer uniqueness is structural (one R per
+        # signer id slot) — check count, then verify the whole cert in one
+        # aggregate launch.  A verifier without aggregation support returns
+        # None and the ViewData is rejected, same as an invalid signature.
+        cert = vd.last_decision_signatures
+        if len(set(cert.signer_ids)) < quorum:
+            raise ValueError(
+                f"only {len(set(cert.signer_ids))} last-decision cert signers"
+            )
+        vac = getattr(verifier, "verify_aggregate_cert", None)
+        aux = vac(cert, vd.last_decision) if vac is not None else None
+        if aux is None:
+            raise ValueError("invalid last-decision quorum cert")
+        return md.latest_sequence
     # Dedup by signer, then batch-verify.
     seen: set[int] = set()
     unique: list[Signature] = []
@@ -327,6 +349,7 @@ class ViewChanger:
         tick_period: float = 1.0,
         on_reconfig: Optional[Callable] = None,
         metrics: Optional[MetricsViewChange] = None,
+        cert_mode: str = "full",
     ) -> None:
         self._sched = scheduler
         self.self_id = self_id
@@ -350,6 +373,7 @@ class ViewChanger:
         self._decisions_per_leader = decisions_per_leader
         self._tick_period = tick_period
         self._on_reconfig = on_reconfig
+        self.cert_mode = cert_mode
 
         self.curr_view = 0
         #: Last view actually installed (realView in the reference).
@@ -704,7 +728,7 @@ class ViewChanger:
         vd = ViewData(
             next_view=self.curr_view,
             last_decision=last_decision,
-            last_decision_signatures=tuple(last_sigs),
+            last_decision_signatures=as_cert(last_sigs),
             in_flight_proposal=in_flight,
             in_flight_prepared=self._in_flight.is_prepared(),
         )
@@ -1099,6 +1123,7 @@ class ViewChanger:
             sync_requester=_InFlightSync(self),
             checkpoint=self._checkpoint,
             decisions_per_leader=self._decisions_per_leader if self._leader_rotation else 0,
+            cert_mode=self.cert_mode,
         )
         view.phase = Phase.PREPARED
         view.in_flight_proposal = proposal

@@ -92,6 +92,28 @@ SIDECAR_KEYS = (
     SIDECAR_WAVE_TENANTS_KEY,
 )
 
+#: Pinned instrument names for half-aggregated quorum certs
+#: (consensus_tpu/models/aggregate.py, Configuration.cert_mode).  The byte
+#: counters account encoded cert-field bytes (wire/codec.py
+#: ``encoded_cert_size``) at each surface a cert crosses — leader broadcast,
+#: WAL persistence, sync catch-up — so the full-vs-half-agg compression
+#: ratio is directly observable per path; the launch/bisection counters
+#: expose the one-MSM-launch economy and its strict fallback.
+WAL_CERT_BYTES_KEY = "wal_cert_bytes_total"
+SYNC_CERT_BYTES_KEY = "sync_cert_bytes_total"
+NET_CERT_BYTES_KEY = "net_cert_bytes_total"
+CERT_BYTES_PER_CERT_KEY = "cert_bytes_per_cert"
+CERT_AGGREGATE_LAUNCHES_KEY = "cert_aggregate_launches"
+CERT_FALLBACK_BISECTIONS_KEY = "cert_fallback_bisections"
+CERT_KEYS = (
+    WAL_CERT_BYTES_KEY,
+    SYNC_CERT_BYTES_KEY,
+    NET_CERT_BYTES_KEY,
+    CERT_BYTES_PER_CERT_KEY,
+    CERT_AGGREGATE_LAUNCHES_KEY,
+    CERT_FALLBACK_BISECTIONS_KEY,
+)
+
 #: THE module-level registry of every pinned instrument name: key -> one-line
 #: description.  Tests and embedder dashboards key on this mapping; every
 #: name here is created by a fresh ``Metrics`` bundle (asserted by
@@ -139,6 +161,18 @@ PINNED_METRIC_KEYS: dict[str, str] = {
         "signatures verified across all sidecar waves",
     SIDECAR_WAVE_TENANTS_KEY:
         "tenants sharing a wave, summed over waves (launches divides it)",
+    WAL_CERT_BYTES_KEY:
+        "encoded quorum-cert bytes persisted to the WAL",
+    SYNC_CERT_BYTES_KEY:
+        "encoded quorum-cert bytes received in sync catch-up chunks",
+    NET_CERT_BYTES_KEY:
+        "encoded quorum-cert bytes broadcast in pre-prepares",
+    CERT_BYTES_PER_CERT_KEY:
+        "encoded bytes per quorum cert assembled or received (histogram)",
+    CERT_AGGREGATE_LAUNCHES_KEY:
+        "half-aggregated cert checks (one MSM launch each)",
+    CERT_FALLBACK_BISECTIONS_KEY:
+        "cert aggregations abandoned to bisection + full-tuple fallback",
 }
 
 
@@ -413,6 +447,32 @@ class MetricsConsensus(_Bundle):
             "fsync in the most recent flush window.",
             ln,
         )
+        # --- half-aggregated quorum certs (cert_mode="half-agg") --------
+        self.wal_cert_bytes = p.new_counter(
+            WAL_CERT_BYTES_KEY,
+            "Encoded quorum-cert bytes persisted to the WAL.",
+            ln,
+        )
+        self.net_cert_bytes = p.new_counter(
+            NET_CERT_BYTES_KEY,
+            "Encoded quorum-cert bytes broadcast in pre-prepares.",
+            ln,
+        )
+        self.cert_bytes_per_cert = p.new_histogram(
+            CERT_BYTES_PER_CERT_KEY,
+            "Encoded bytes per quorum cert assembled or received.",
+            ln,
+        )
+        self.cert_aggregate_launches = p.new_counter(
+            CERT_AGGREGATE_LAUNCHES_KEY,
+            "Half-aggregated cert checks (one MSM launch each).",
+            ln,
+        )
+        self.cert_fallback_bisections = p.new_counter(
+            CERT_FALLBACK_BISECTIONS_KEY,
+            "Cert aggregations abandoned to bisection + full-tuple fallback.",
+            ln,
+        )
 
 
 class MetricsView(_Bundle):
@@ -488,6 +548,11 @@ class MetricsSync(_Bundle):
         self.count_peer_demotions = p.new_counter(
             "sync_count_peer_demotions",
             "Peer score demotions (failed fetches + forged chunks).",
+            ln,
+        )
+        self.sync_cert_bytes = p.new_counter(
+            SYNC_CERT_BYTES_KEY,
+            "Encoded quorum-cert bytes received in sync catch-up chunks.",
             ln,
         )
 
@@ -741,5 +806,12 @@ __all__ = [
     "SIDECAR_WAVE_SIGNATURES_KEY",
     "SIDECAR_WAVE_TENANTS_KEY",
     "SIDECAR_KEYS",
+    "WAL_CERT_BYTES_KEY",
+    "SYNC_CERT_BYTES_KEY",
+    "NET_CERT_BYTES_KEY",
+    "CERT_BYTES_PER_CERT_KEY",
+    "CERT_AGGREGATE_LAUNCHES_KEY",
+    "CERT_FALLBACK_BISECTIONS_KEY",
+    "CERT_KEYS",
     "PINNED_METRIC_KEYS",
 ]

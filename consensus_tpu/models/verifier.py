@@ -31,7 +31,7 @@ from consensus_tpu.models.ed25519 import (
     Ed25519BatchVerifier,
     Ed25519RandomizedBatchVerifier,
 )
-from consensus_tpu.types import Proposal, RequestInfo, Signature
+from consensus_tpu.types import Proposal, QuorumCert, RequestInfo, Signature
 
 _COMMIT_TAG = b"ctpu/commit"
 _RAW_TAG = b"ctpu/raw"
@@ -176,6 +176,24 @@ class Ed25519VerifierMixin(Verifier):
         #: Consumed by api.deps facades (CryptoApp etc.) to decide whether
         #: the default multi-batch loop may coalesce through this verifier.
         self.batch_verify_enabled = bool(getattr(engine, "randomized", False))
+        self._aggregator = None
+
+    #: Half-aggregated quorum certs are Ed25519-only (the aggregator's MSM
+    #: rides the Ed25519 shared-doubling kernel); the P-256 subclass
+    #: overrides this back to False.
+    supports_cert_aggregation = True
+
+    @property
+    def aggregator(self):
+        """The lazily-built :class:`~consensus_tpu.models.aggregate.
+        HalfAggregator` sharing this verifier's engine (same padding and
+        device-threshold knobs, so cert checks route host/device exactly
+        like the engine's own batches)."""
+        if self._aggregator is None:
+            from consensus_tpu.models.aggregate import HalfAggregator
+
+            self._aggregator = HalfAggregator(engine=self._engine)
+        return self._aggregator
 
     def set_public_keys(self, public_keys: Mapping[int, bytes]) -> None:
         """Swap the key registry (reconfiguration)."""
@@ -196,6 +214,12 @@ class Ed25519VerifierMixin(Verifier):
         :meth:`verify_consenter_sigs_batch` would launch — exposed so a
         caller can append them to a larger wave and run ONE engine call
         covering requests + consenter certs."""
+        if isinstance(signatures, QuorumCert):
+            raise ValueError(
+                "consenter_sig_triples cannot flatten a half-aggregated "
+                "QuorumCert into a strict-verification wave — route it "
+                "through verify_aggregate_cert instead"
+            )
         messages, sigs, keys = [], [], []
         known: list[bool] = []
         for sig in signatures:
@@ -205,6 +229,69 @@ class Ed25519VerifierMixin(Verifier):
             sigs.append(sig.value)
             keys.append(key if key is not None else b"")
         return messages, sigs, keys, known
+
+    # --- half-aggregated quorum certs (models/aggregate.py) --------------
+
+    def aggregate_cert(
+        self, proposal: Proposal, signatures: Sequence[Signature]
+    ) -> Optional[QuorumCert]:
+        if not self.supports_cert_aggregation:
+            return None
+        if isinstance(signatures, QuorumCert):
+            return signatures
+        sigs = list(signatures)
+        if not sigs:
+            return None
+        messages, values, keys = [], [], []
+        for sig in sigs:
+            key = self._public_keys.get(sig.id)
+            if key is None:
+                return None
+            messages.append(commit_message(proposal, sig.msg))
+            values.append(sig.value)
+            keys.append(key)
+        agg, _bad = self.aggregator.aggregate(messages, values, keys)
+        if agg is None:
+            return None
+        rs, s_agg = agg
+        aux_table: list[bytes] = []
+        aux_index: list[int] = []
+        seen: dict[bytes, int] = {}
+        for sig in sigs:
+            idx = seen.get(sig.msg)
+            if idx is None:
+                idx = len(aux_table)
+                seen[sig.msg] = idx
+                aux_table.append(sig.msg)
+            aux_index.append(idx)
+        return QuorumCert(
+            signer_ids=tuple(s.id for s in sigs),
+            rs=tuple(rs),
+            s_agg=s_agg,
+            aux_table=tuple(aux_table),
+            aux_index=tuple(aux_index),
+        )
+
+    def verify_aggregate_cert(
+        self, cert: QuorumCert, proposal: Proposal
+    ) -> Optional[list[bytes]]:
+        if not self.supports_cert_aggregation or len(cert) == 0:
+            return None
+        messages, keys, aux = [], [], []
+        for comp in cert:
+            key = self._public_keys.get(comp.id)
+            if key is None:
+                return None
+            messages.append(commit_message(proposal, comp.msg))
+            keys.append(key)
+            aux.append(comp.msg)
+        try:
+            ok = self.aggregator.verify(
+                messages, list(cert.rs), cert.s_agg, keys
+            )
+        except ValueError:
+            return None
+        return aux if ok else None
 
     # --- single-signature paths (host) ----------------------------------
 
@@ -229,6 +316,11 @@ class Ed25519VerifierMixin(Verifier):
     def verify_consenter_sigs_batch(
         self, signatures: Sequence[Signature], proposal: Proposal
     ) -> list[Optional[bytes]]:
+        if isinstance(signatures, QuorumCert):
+            aux = self.verify_aggregate_cert(signatures, proposal)
+            if aux is None:
+                return [None] * len(signatures)
+            return list(aux)
         messages, sigs, keys, known = self.consenter_sig_triples(
             signatures, proposal
         )
@@ -244,7 +336,24 @@ class Ed25519VerifierMixin(Verifier):
         """Flatten every (proposal, quorum cert) group into ONE device batch
         — the per-item message array already lets signatures over different
         proposals share a launch, so a whole sync chunk verifies at the same
-        kernel throughput as a single quorum."""
+        kernel throughput as a single quorum.
+
+        Half-aggregated groups verify one aggregate check per cert instead;
+        mixing cert kinds in one call raises (contradiction guard — see the
+        port default in api/deps.py)."""
+        if groups:
+            kinds = {isinstance(sigs, QuorumCert) for _, sigs in groups}
+            if len(kinds) > 1:
+                raise ValueError(
+                    "verify_consenter_sigs_multi_batch: groups mix "
+                    "half-aggregated QuorumCerts with full signature tuples "
+                    "— cert modes contradict; partition the groups first"
+                )
+            if kinds == {True}:
+                return [
+                    self.verify_consenter_sigs_batch(cert, proposal)
+                    for proposal, cert in groups
+                ]
         messages, sigs, keys, known = [], [], [], []
         for proposal, cert in groups:
             for sig in cert:
@@ -309,6 +418,10 @@ class EcdsaP256VerifierMixin(Ed25519VerifierMixin):
     """Signature-verification half of the Verifier port over ECDSA-P256 —
     same registry/batching semantics as the Ed25519 mixin, different curve
     engine."""
+
+    # Half-aggregation is Ed25519-only: the aggregate relation rides the
+    # Ed25519 group law, there is no P-256 analogue here.
+    supports_cert_aggregation = False
 
     def __init__(self, public_keys: Mapping[int, bytes], *, engine=None) -> None:
         from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier

@@ -19,9 +19,9 @@ from typing import Callable, Dict, Optional, Sequence, Set, Union
 from consensus_tpu.api.deps import Synchronizer, Verifier
 from consensus_tpu.sync.store import DecisionStore
 from consensus_tpu.sync.transport import SyncTransport
-from consensus_tpu.types import Decision, Reconfig, SyncResponse
+from consensus_tpu.types import Decision, QuorumCert, Reconfig, SyncResponse, as_cert
 from consensus_tpu.utils.quorum import compute_quorum
-from consensus_tpu.wire.codec import CodecError, decode_view_metadata
+from consensus_tpu.wire.codec import CodecError, decode_view_metadata, encoded_cert_size
 from consensus_tpu.wire.messages import SyncChunk, SyncRequest, SyncSnapshotMeta
 
 logger = logging.getLogger("consensus_tpu.sync")
@@ -240,12 +240,29 @@ class LedgerSynchronizer(Synchronizer):
 
         required = self.threshold(len(self._membership()))
 
-        # One batched verifier call for every cert in the chunk.
+        # One batched verifier call per cert FORMAT in the chunk.  A ledger
+        # whose cert_mode flipped mid-history (e.g. at a membership epoch
+        # boundary) serves chunks mixing full signature tuples with
+        # half-aggregated QuorumCerts; verify_consenter_sigs_multi_batch
+        # rejects mixed groups by contract, so partition into homogeneous
+        # sub-calls and merge the verdicts back in chunk order.
         groups = list(zip(chunk.decisions, chunk.quorum_certs))
-        results = self.verifier.verify_consenter_sigs_multi_batch(groups)
+        full_idx = [i for i, (_, c) in enumerate(groups) if not isinstance(c, QuorumCert)]
+        agg_idx = [i for i, (_, c) in enumerate(groups) if isinstance(c, QuorumCert)]
+        results: list = [None] * len(groups)
+        for idx_list in (full_idx, agg_idx):
+            if not idx_list:
+                continue
+            sub = self.verifier.verify_consenter_sigs_multi_batch(
+                [groups[i] for i in idx_list]
+            )
+            for i, r in zip(idx_list, sub):
+                results[i] = r
         total_sigs = sum(len(cert) for cert in chunk.quorum_certs)
         self.metrics.count_sig_verifications.add(total_sigs)
         self.metrics.sigs_per_chunk.observe(total_sigs)
+        for i in agg_idx:
+            self.metrics.sync_cert_bytes.add(encoded_cert_size(groups[i][1]))
 
         for i, (proposal, cert) in enumerate(groups):
             valid_signers = {
@@ -262,7 +279,7 @@ class LedgerSynchronizer(Synchronizer):
 
         reconfig = Reconfig()
         for proposal, cert in groups:
-            self.store.append(Decision(proposal=proposal, signatures=tuple(cert)))
+            self.store.append(Decision(proposal=proposal, signatures=as_cert(cert)))
             if self._reconfig_of is not None:
                 r = self._reconfig_of(proposal)
                 if r.in_latest_decision:

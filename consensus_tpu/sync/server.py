@@ -11,7 +11,7 @@ without a second metadata probe.
 from __future__ import annotations
 
 from consensus_tpu.sync.store import DecisionStore
-from consensus_tpu.types import Decision
+from consensus_tpu.types import Decision, as_cert
 from consensus_tpu.wire.codec import decode_message, encode_message
 from consensus_tpu.wire.messages import SyncChunk, SyncRequest, SyncSnapshotMeta
 
@@ -80,7 +80,9 @@ class SyncServer:
                 break
             budget -= size
             decisions.append(d.proposal)
-            certs.append(tuple(d.signatures))
+            # Serve the cert in its stored format: a half-aggregated
+            # QuorumCert passes through intact, a signature list as a tuple.
+            certs.append(as_cert(d.signatures))
         self.chunks_served += 1
         return SyncChunk(
             from_seq=from_seq,

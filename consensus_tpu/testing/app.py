@@ -41,6 +41,7 @@ from consensus_tpu.types import (
     RequestInfo,
     Signature,
     SyncResponse,
+    as_cert,
 )
 
 # --- request / batch encoding --------------------------------------------
@@ -211,7 +212,7 @@ class TestApp(Application, Assembler, Signer, Verifier, Synchronizer):
 
     # Application
     def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
-        decision = Decision(proposal=proposal, signatures=tuple(signatures))
+        decision = Decision(proposal=proposal, signatures=as_cert(signatures))
         self.ledger.append(decision)
         # Commit-path delivery hooks (the chaos invariant monitor lives
         # here): called AFTER the append so a hook sees the ledger it is

@@ -309,12 +309,17 @@ class ChaosEngine:
         the ``verify_batch`` contract.  The mesh parity gates use it to run
         the SAME schedule through sharded engines and assert byte-identical
         ledgers/event logs against the single-device run."""
-        if crypto not in (None, "ed25519", "ed25519-batch"):
+        if crypto not in (None, "ed25519", "ed25519-batch", "ed25519-halfagg"):
             raise ValueError(f"unknown chaos crypto mode {crypto!r}")
         if engine_factory is not None and crypto is None:
             raise ValueError("engine_factory requires a crypto mode")
         self.schedule = schedule
         self.config_tweaks = dict(config_tweaks or DEFAULT_TWEAKS)
+        if crypto == "ed25519-halfagg":
+            # Same strict engine as "ed25519", but every decided quorum is
+            # compressed into a half-aggregated QuorumCert — the ledger/
+            # event-log parity gate runs the SAME schedule under both modes.
+            self.config_tweaks.setdefault("cert_mode", "half-agg")
         #: A schedule carrying churn actions runs with the membership
         #: harness installed and epoch tagging on — stale-epoch traffic
         #: from evictees must be dropped at ingress, not interpreted.
@@ -594,6 +599,32 @@ class ChaosEngine:
                 nid, self.cluster, signers[nid],
                 SigOnlyVerifier(keys, engine=engine),
             )
+            if self.crypto == "ed25519-halfagg":
+                self._arm_halfagg_byz(nid, node.app)
+
+    def _arm_halfagg_byz(self, nid: int, app) -> None:
+        """Half-agg byzantine arm: when this node has a byzantine rule armed,
+        occasionally corrupt ONE component signature inside an otherwise
+        valid quorum right before aggregation.  The aggregator's self-check
+        must catch it, the bisection fallback must localize the bad index,
+        and the view degrades to the full signature tuple — ledgers stay
+        clean.  Rolls ride the crypto-only ``_sig_rng`` stream; honest runs
+        (no byzantine rule) consume NO rolls, keeping honest half-agg
+        schedules replayable against other crypto modes."""
+        inner = app.aggregate_cert
+
+        def aggregate_cert(proposal, signatures, _inner=inner, _nid=nid):
+            rate = self._byz_rules.get(_nid)
+            if rate and signatures and self._sig_rng.random() < rate * 0.5:
+                sigs = list(signatures)
+                i = self._sig_rng.randrange(len(sigs))
+                flipped = bytearray(sigs[i].value)
+                flipped[self._sig_rng.randrange(len(flipped))] ^= 0xFF
+                sigs[i] = dataclasses.replace(sigs[i], value=bytes(flipped))
+                return _inner(proposal, tuple(sigs))
+            return _inner(proposal, signatures)
+
+        app.aggregate_cert = aggregate_cert
 
     # --- the run ------------------------------------------------------------
 

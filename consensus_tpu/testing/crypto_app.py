@@ -26,7 +26,7 @@ from typing import Mapping, Optional, Sequence
 
 from consensus_tpu.models.verifier import Ed25519VerifierMixin
 from consensus_tpu.testing.app import TestApp, pack_batch, unpack_batch
-from consensus_tpu.types import RequestInfo
+from consensus_tpu.types import QuorumCert, RequestInfo
 
 _REQ_TAG = b"ctpu/request"
 
@@ -82,6 +82,19 @@ class CryptoApp(TestApp):
 
     def auxiliary_data(self, msg):
         return self._verifier.auxiliary_data(msg)
+
+    # Half-aggregated quorum certs: delegate straight to the crypto half.
+    @property
+    def supports_cert_aggregation(self):
+        return getattr(self._verifier, "supports_cert_aggregation", False)
+
+    def aggregate_cert(self, proposal, signatures):
+        agg = getattr(self._verifier, "aggregate_cert", None)
+        return agg(proposal, signatures) if agg is not None else None
+
+    def verify_aggregate_cert(self, cert, proposal):
+        vac = getattr(self._verifier, "verify_aggregate_cert", None)
+        return vac(cert, proposal) if vac is not None else None
 
 
 class ClientKeyring:
@@ -197,6 +210,13 @@ class SignedRequestApp(CryptoApp):
         path's order: request failures raise before any cert verdict is
         consumed."""
         if getattr(self._verifier, "engine", None) is not self._engine:
+            return super().verify_proposal_and_prev_commits(
+                proposal, prev_commits, prev_proposal
+            )
+        if isinstance(prev_commits, QuorumCert):
+            # A half-aggregated cert verifies through its own MSM launch —
+            # it has no per-signature triples to splice into the request
+            # wave; the split path routes it via verify_aggregate_cert.
             return super().verify_proposal_and_prev_commits(
                 proposal, prev_commits, prev_proposal
             )

@@ -196,13 +196,27 @@ class InvariantMonitor:
         app = self.cluster.nodes[node_id].app
         valid: set[int] = set()
         bad: list[str] = []
-        for sig in decision.signatures:
-            try:
-                app.verify_consenter_sig(sig, decision.proposal)
-            except Exception as err:
-                bad.append(f"id={sig.id}: {err}")
-                continue
-            valid.add(sig.id)
+        if getattr(decision.signatures, "s_agg", None) is not None:
+            # Half-aggregated QuorumCert: the proof is all-or-nothing — one
+            # aggregate verification vouches for every listed signer at once.
+            cert = decision.signatures
+            vac = getattr(app, "verify_aggregate_cert", None)
+            aux = vac(cert, decision.proposal) if vac is not None else None
+            if aux is not None:
+                valid = set(cert.signer_ids)
+            else:
+                bad.append(
+                    f"half-agg cert with signers {sorted(set(cert.signer_ids))} "
+                    "failed aggregate verification"
+                )
+        else:
+            for sig in decision.signatures:
+                try:
+                    app.verify_consenter_sig(sig, decision.proposal)
+                except Exception as err:
+                    bad.append(f"id={sig.id}: {err}")
+                    continue
+                valid.add(sig.id)
         seq = _seq_of(decision.proposal)
         quorum = self.quorum
         directory = getattr(self.cluster, "membership_directory", None)

@@ -93,11 +93,66 @@ class Signature:
 class Decision:
     """A committed proposal together with its quorum of signatures.
 
+    ``signatures`` is either a plain tuple of :class:`Signature` (the full
+    cert, ``cert_mode="full"``) or a :class:`QuorumCert` — which quacks like
+    that tuple (len / iteration / indexing yield per-signer ``Signature``
+    views) so cert-shape-agnostic consumers need no branch.
     Parity: reference pkg/types/types.go:39-42.
     """
 
     proposal: Proposal
-    signatures: tuple[Signature, ...] = ()
+    signatures: "tuple[Signature, ...] | QuorumCert" = ()
+
+
+@dataclass(frozen=True)
+class QuorumCert:
+    """Half-aggregated Ed25519 quorum certificate (arXiv:2302.00418).
+
+    Instead of n full 64-byte signatures, the cert keeps each signer's
+    32-byte nonce commitment ``Rᵢ`` plus ONE aggregate scalar
+    ``s_agg = Σ zᵢ·sᵢ mod L`` under transcript-derived Fiat–Shamir
+    coefficients — ~64n bytes shrink to ~32n + 32.  ``aux_table`` holds the
+    deduplicated per-signer auxiliary payloads (Signature.msg), indexed by
+    ``aux_index`` so the common all-identical-aux case costs one entry.
+
+    The sequence protocol (``len`` / iteration / indexing) yields
+    per-component :class:`Signature` views with ``value=Rᵢ`` — enough for
+    every signer-identity consumer (quorum counting, blacklists, epoch
+    checks).  Those views do NOT verify individually; a cert only verifies
+    as a whole through ``Verifier.verify_aggregate_cert``.
+    """
+
+    signer_ids: tuple[int, ...] = ()
+    rs: tuple[bytes, ...] = ()
+    s_agg: bytes = b""
+    aux_table: tuple[bytes, ...] = ()
+    aux_index: tuple[int, ...] = ()
+
+    def __len__(self) -> int:
+        return len(self.signer_ids)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self.signer_ids)))
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return tuple(
+                self[j] for j in range(*i.indices(len(self.signer_ids)))
+            )
+        return Signature(
+            id=self.signer_ids[i],
+            value=self.rs[i],
+            msg=self.aux_table[self.aux_index[i]],
+        )
+
+
+def as_cert(signatures):
+    """Preserve a :class:`QuorumCert` through call sites that historically
+    flattened signature sequences with ``tuple(...)`` — flattening a cert
+    to its component views would silently discard ``s_agg``."""
+    if isinstance(signatures, QuorumCert):
+        return signatures
+    return tuple(signatures)
 
 
 @dataclass(frozen=True)
@@ -161,7 +216,7 @@ class Checkpoint:
     def set(self, proposal: Proposal, signatures: Sequence[Signature]) -> None:
         with self._lock:
             self._proposal = proposal
-            self._signatures = tuple(signatures)
+            self._signatures = as_cert(signatures)
 
 
 __all__ = [
@@ -169,6 +224,8 @@ __all__ = [
     "Proposal",
     "Signature",
     "Decision",
+    "QuorumCert",
+    "as_cert",
     "Reconfig",
     "SyncResponse",
     "ViewSequence",

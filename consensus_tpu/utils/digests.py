@@ -16,7 +16,13 @@ from consensus_tpu.types import Signature
 
 
 def commit_signatures_digest(sigs: Sequence[Signature]) -> bytes:
-    """Digest of an ordered list of commit signatures; empty input -> b''."""
+    """Digest of an ordered list of commit signatures; empty input -> b''.
+
+    A half-aggregated ``types.QuorumCert`` (duck-typed via its ``s_agg``
+    attribute) is bound through its component view — ordered
+    (signer, R, aux) triples — PLUS the aggregate scalar, so two certs over
+    the same components but different ``s_agg`` bytes digest differently.
+    """
     if not sigs:
         return b""
     h = hashlib.sha256()
@@ -26,6 +32,11 @@ def commit_signatures_digest(sigs: Sequence[Signature]) -> bytes:
         h.update(sig.value)
         h.update(struct.pack(">Q", len(sig.msg)))
         h.update(sig.msg)
+    s_agg = getattr(sigs, "s_agg", None)
+    if s_agg is not None:
+        h.update(b"\x00s_agg")
+        h.update(struct.pack(">Q", len(s_agg)))
+        h.update(s_agg)
     return h.digest()
 
 

@@ -21,8 +21,9 @@ from __future__ import annotations
 import struct
 from typing import Callable, Optional, Sequence
 
-from consensus_tpu.types import Proposal, Signature
+from consensus_tpu.types import Proposal, QuorumCert, Signature
 from consensus_tpu.wire.messages import (
+    Cert,
     Commit,
     ConsensusMessage,
     EpochTagged,
@@ -49,6 +50,14 @@ from consensus_tpu.wire.messages import (
 )
 
 _VERSION = 1
+# Wire v2: cert-carrying fields (PrePrepare.prev_commit_signatures,
+# SyncChunk.quorum_certs, ViewData.last_decision_signatures) gain a
+# cert-kind discriminator so a half-aggregated QuorumCert can ride where a
+# signature tuple used to.  v2 is emitted ONLY when a QuorumCert is
+# actually present (lowest-lossless-version rule, same as the WAL's
+# ProposedRecord pattern), so cert_mode="full" traffic stays bit-for-bit
+# the v1 seed encoding.
+_WIRE_VERSION = 2
 
 # Domain discriminators: the second envelope byte separates the wire-message
 # and WAL-record encodings so bytes from one domain can never silently decode
@@ -185,6 +194,76 @@ def _r_signature(r: _Reader) -> Signature:
     return Signature(id=sid, value=value, msg=msg)
 
 
+def _w_quorum_cert_body(w: _Writer, c: QuorumCert) -> None:
+    if not (len(c.signer_ids) == len(c.rs) == len(c.aux_index)):
+        raise CodecError(
+            f"QuorumCert parallel-field length mismatch: "
+            f"{len(c.signer_ids)} ids, {len(c.rs)} rs, "
+            f"{len(c.aux_index)} aux indices"
+        )
+    w.seq(c.signer_ids, w.u64)
+    w.seq(c.rs, w.blob)
+    w.blob(c.s_agg)
+    w.seq(c.aux_table, w.blob)
+    w.seq(c.aux_index, w.u64)
+
+
+def _r_quorum_cert_body(r: _Reader) -> QuorumCert:
+    signer_ids = r.seq(r.u64)
+    rs = r.seq(r.blob)
+    s_agg = r.blob()
+    aux_table = r.seq(r.blob)
+    aux_index = r.seq(r.u64)
+    if not (len(signer_ids) == len(rs) == len(aux_index)):
+        raise CodecError(
+            f"QuorumCert parallel-field length mismatch: "
+            f"{len(signer_ids)} ids, {len(rs)} rs, {len(aux_index)} aux indices"
+        )
+    for i in aux_index:
+        if i >= len(aux_table):
+            raise CodecError(
+                f"QuorumCert aux_index {i} out of range "
+                f"(aux_table has {len(aux_table)} entries)"
+            )
+    return QuorumCert(
+        signer_ids=signer_ids,
+        rs=rs,
+        s_agg=s_agg,
+        aux_table=aux_table,
+        aux_index=aux_index,
+    )
+
+
+def _w_cert(w: _Writer, cert: Cert) -> None:
+    """v2 cert field: a one-byte kind discriminator, then either the v1
+    signature-tuple body (kind 0) or a QuorumCert body (kind 1)."""
+    if isinstance(cert, QuorumCert):
+        w.u8(1)
+        _w_quorum_cert_body(w, cert)
+    else:
+        w.u8(0)
+        w.seq(cert, lambda s: _w_signature(w, s))
+
+
+def _r_cert(r: _Reader) -> Cert:
+    kind = r.u8()
+    if kind == 0:
+        return r.seq(lambda: _r_signature(r))
+    if kind == 1:
+        return _r_quorum_cert_body(r)
+    raise CodecError(f"unknown cert kind {kind}")
+
+
+def encoded_cert_size(cert: Cert) -> int:
+    """Encoded byte size of ONE cert field (kind byte included) — the unit
+    the pinned ``*_cert_bytes_total`` counters account in, so wire/WAL/sync
+    byte ratios compare cert payloads, not the unrelated message framing
+    around them."""
+    w = _Writer()
+    _w_cert(w, cert)
+    return len(w.getvalue())
+
+
 def _w_view_metadata(w: _Writer, m: ViewMetadata) -> None:
     w.u64(m.view_id)
     w.u64(m.latest_sequence)
@@ -211,18 +290,26 @@ def _r_view_metadata(r: _Reader) -> ViewMetadata:
 # --- per-message bodies ---------------------------------------------------
 
 
-def _w_pre_prepare(w: _Writer, m: PrePrepare) -> None:
+def _w_pre_prepare(w: _Writer, m: PrePrepare, version: int = 1) -> None:
     w.u64(m.view)
     w.u64(m.seq)
     _w_proposal(w, m.proposal)
-    w.seq(m.prev_commit_signatures, lambda s: _w_signature(w, s))
+    if version >= 2:
+        _w_cert(w, m.prev_commit_signatures)
+    else:
+        if isinstance(m.prev_commit_signatures, QuorumCert):
+            raise CodecError("QuorumCert prev_commit_signatures need wire v2")
+        w.seq(m.prev_commit_signatures, lambda s: _w_signature(w, s))
 
 
-def _r_pre_prepare(r: _Reader) -> PrePrepare:
+def _r_pre_prepare(r: _Reader, version: int = 1) -> PrePrepare:
     view = r.u64()
     seq = r.u64()
     proposal = _r_proposal(r)
-    prev_sigs = r.seq(lambda: _r_signature(r))
+    if version >= 2:
+        prev_sigs = _r_cert(r)
+    else:
+        prev_sigs = r.seq(lambda: _r_signature(r))
     return PrePrepare(
         view=view, seq=seq, proposal=proposal, prev_commit_signatures=prev_sigs
     )
@@ -341,7 +428,7 @@ def _r_sync_request(r: _Reader) -> SyncRequest:
     return SyncRequest(from_seq=from_seq, to_seq=to_seq)
 
 
-def _w_sync_chunk(w: _Writer, m: SyncChunk) -> None:
+def _w_sync_chunk(w: _Writer, m: SyncChunk, version: int = 1) -> None:
     if len(m.decisions) != len(m.quorum_certs):
         raise CodecError(
             f"SyncChunk decisions/quorum_certs length mismatch: "
@@ -350,17 +437,25 @@ def _w_sync_chunk(w: _Writer, m: SyncChunk) -> None:
     w.u64(m.from_seq)
     w.u64(m.height)
     w.seq(m.decisions, lambda p: _w_proposal(w, p))
-    w.seq(
-        m.quorum_certs,
-        lambda cert: w.seq(cert, lambda s: _w_signature(w, s)),
-    )
+    if version >= 2:
+        w.seq(m.quorum_certs, lambda cert: _w_cert(w, cert))
+    else:
+        if any(isinstance(c, QuorumCert) for c in m.quorum_certs):
+            raise CodecError("QuorumCert endorsements need wire v2")
+        w.seq(
+            m.quorum_certs,
+            lambda cert: w.seq(cert, lambda s: _w_signature(w, s)),
+        )
 
 
-def _r_sync_chunk(r: _Reader) -> SyncChunk:
+def _r_sync_chunk(r: _Reader, version: int = 1) -> SyncChunk:
     from_seq = r.u64()
     height = r.u64()
     decisions = r.seq(lambda: _r_proposal(r))
-    certs = r.seq(lambda: r.seq(lambda: _r_signature(r)))
+    if version >= 2:
+        certs = r.seq(lambda: _r_cert(r))
+    else:
+        certs = r.seq(lambda: r.seq(lambda: _r_signature(r)))
     if len(decisions) != len(certs):
         raise CodecError(
             f"SyncChunk decisions/quorum_certs length mismatch: "
@@ -397,8 +492,16 @@ def _r_epoch_tagged(r: _Reader) -> EpochTagged:
     return EpochTagged(epoch=epoch, msg=inner)
 
 
+def _w_quorum_cert(w: _Writer, m: QuorumCert) -> None:
+    _w_quorum_cert_body(w, m)
+
+
+def _r_quorum_cert(r: _Reader) -> QuorumCert:
+    return _r_quorum_cert_body(r)
+
+
 # Tag assignments mirror the reference's oneof field numbers
-# (smartbftprotos/messages.proto:15-26) for easy cross-auditing; tags 11-13
+# (smartbftprotos/messages.proto:15-26) for easy cross-auditing; tags 11-15
 # are ours — the reference has no sync wire protocol (Fabric's block puller
 # fills that role outside the library).
 _MESSAGE_CODECS: dict[int, tuple[type, Callable, Callable]] = {
@@ -417,9 +520,37 @@ _MESSAGE_CODECS: dict[int, tuple[type, Callable, Callable]] = {
     13: (SyncSnapshotMeta, _w_sync_snapshot_meta, _r_sync_snapshot_meta),
     # 14 is ours: the membership-epoch envelope (no reference counterpart).
     14: (EpochTagged, _w_epoch_tagged, _r_epoch_tagged),
+    # 15 is ours: a standalone half-aggregated quorum cert (models/aggregate).
+    15: (QuorumCert, _w_quorum_cert, _r_quorum_cert),
 }
 
 _TAG_BY_TYPE = {cls: tag for tag, (cls, _, _) in _MESSAGE_CODECS.items()}
+
+# Message kinds whose body layout depends on the envelope version (their
+# writers/readers take an extra version argument).
+_VERSIONED_WIRE_TYPES = (PrePrepare, SyncChunk)
+
+
+def _wire_version_for(msg: ConsensusMessage) -> int:
+    """Lowest wire version that expresses ``msg`` losslessly.
+
+    Same rule as :func:`_saved_version_for`: v2 is emitted ONLY when a
+    half-aggregated QuorumCert is actually present, so cert_mode="full"
+    traffic stays bit-for-bit the v1 seed encoding (and remains decodable
+    by pre-upgrade binaries).  An EpochTagged envelope stays v1 even when
+    its inner message needs v2 — the inner blob is self-versioned.
+    """
+    if isinstance(msg, QuorumCert):
+        return 2
+    if isinstance(msg, PrePrepare) and isinstance(
+        msg.prev_commit_signatures, QuorumCert
+    ):
+        return 2
+    if isinstance(msg, SyncChunk) and any(
+        isinstance(c, QuorumCert) for c in msg.quorum_certs
+    ):
+        return 2
+    return 1
 
 
 def encode_message(msg: ConsensusMessage) -> bytes:
@@ -427,19 +558,25 @@ def encode_message(msg: ConsensusMessage) -> bytes:
     tag = _TAG_BY_TYPE.get(type(msg))
     if tag is None:
         raise CodecError(f"not a wire message: {type(msg).__name__}")
+    version = _wire_version_for(msg)
     w = _Writer()
-    w.u8(_VERSION)
+    w.u8(version)
     w.u8(_DOMAIN_WIRE)
     w.u8(tag)
-    _MESSAGE_CODECS[tag][1](w, msg)
+    if isinstance(msg, _VERSIONED_WIRE_TYPES):
+        _MESSAGE_CODECS[tag][1](w, msg, version)
+    else:
+        _MESSAGE_CODECS[tag][1](w, msg)
     return w.getvalue()
 
 
 def decode_message(buf: bytes) -> ConsensusMessage:
-    """Parse bytes produced by :func:`encode_message`."""
+    """Parse bytes produced by :func:`encode_message` (any accepted
+    version — mixed-version clusters exchange v1 traffic until a
+    QuorumCert actually rides a message)."""
     r = _Reader(buf)
     version = r.u8()
-    if version != _VERSION:
+    if not 1 <= version <= _WIRE_VERSION:
         raise CodecError(f"unsupported codec version {version}")
     if r.u8() != _DOMAIN_WIRE:
         raise CodecError("not a wire-message encoding (wrong domain byte)")
@@ -447,7 +584,10 @@ def decode_message(buf: bytes) -> ConsensusMessage:
     entry = _MESSAGE_CODECS.get(tag)
     if entry is None:
         raise CodecError(f"unknown message tag {tag}")
-    msg = entry[2](r)
+    if issubclass(entry[0], _VERSIONED_WIRE_TYPES):
+        msg = entry[2](r, version)
+    else:
+        msg = entry[2](r)
     r.expect_end()
     return msg
 
@@ -457,12 +597,23 @@ def decode_message(buf: bytes) -> ConsensusMessage:
 
 def encode_view_data(vd: ViewData) -> bytes:
     """Serialize ViewData — these bytes are what gets signed and embedded in
-    ``SignedViewData.raw_view_data`` (reference viewchanger.go:433-456)."""
+    ``SignedViewData.raw_view_data`` (reference viewchanger.go:433-456).
+
+    v2 (emitted only when the last-decision proof is a half-aggregated
+    QuorumCert) carries the cert-kind discriminator; full-signature view
+    data stays bit-for-bit v1.
+    """
+    version = (
+        2 if isinstance(vd.last_decision_signatures, QuorumCert) else _VERSION
+    )
     w = _Writer()
-    w.u8(_VERSION)
+    w.u8(version)
     w.u64(vd.next_view)
     _w_opt_proposal(w, vd.last_decision)
-    w.seq(vd.last_decision_signatures, lambda s: _w_signature(w, s))
+    if version >= 2:
+        _w_cert(w, vd.last_decision_signatures)
+    else:
+        w.seq(vd.last_decision_signatures, lambda s: _w_signature(w, s))
     _w_opt_proposal(w, vd.in_flight_proposal)
     w.boolean(vd.in_flight_prepared)
     return w.getvalue()
@@ -471,11 +622,14 @@ def encode_view_data(vd: ViewData) -> bytes:
 def decode_view_data(buf: bytes) -> ViewData:
     r = _Reader(buf)
     version = r.u8()
-    if version != _VERSION:
+    if not 1 <= version <= _WIRE_VERSION:
         raise CodecError(f"unsupported codec version {version}")
     next_view = r.u64()
     last_decision = _r_opt_proposal(r)
-    last_sigs = r.seq(lambda: _r_signature(r))
+    if version >= 2:
+        last_sigs = _r_cert(r)
+    else:
+        last_sigs = r.seq(lambda: _r_signature(r))
     in_flight = _r_opt_proposal(r)
     prepared = r.boolean()
     r.expect_end()
@@ -528,14 +682,16 @@ def decode_view_metadata(buf: bytes) -> ViewMetadata:
 
 
 def _w_proposed_record(w: _Writer, m: ProposedRecord, version: int = 2) -> None:
-    _w_pre_prepare(w, m.pre_prepare)
+    # Saved v3 records encode the nested PrePrepare at wire v2 so its
+    # prev-commit cert field can hold a QuorumCert.
+    _w_pre_prepare(w, m.pre_prepare, 2 if version >= 3 else 1)
     _w_prepare(w, m.prepare)
     if version >= 2:
         w.boolean(m.verified)
 
 
 def _r_proposed_record(r: _Reader, version: int) -> ProposedRecord:
-    pp = _r_pre_prepare(r)
+    pp = _r_pre_prepare(r, 2 if version >= 3 else 1)
     p = _r_prepare(r)
     # v1 records predate the flag; they were only ever written after
     # verification succeeded (the strict verify-then-persist order).
@@ -543,12 +699,22 @@ def _r_proposed_record(r: _Reader, version: int) -> ProposedRecord:
     return ProposedRecord(pre_prepare=pp, prepare=p, verified=verified)
 
 
-def _w_saved_commit(w: _Writer, m: SavedCommit) -> None:
+def _w_saved_commit(w: _Writer, m: SavedCommit, version: int = 1) -> None:
     _w_commit(w, m.commit)
+    if version >= 3:
+        w.boolean(m.cert is not None)
+        if m.cert is not None:
+            _w_quorum_cert_body(w, m.cert)
+    elif m.cert is not None:
+        raise CodecError("SavedCommit.cert needs saved v3")
 
 
 def _r_saved_commit(r: _Reader, version: int) -> SavedCommit:
-    return SavedCommit(commit=_r_commit(r))
+    commit = _r_commit(r)
+    cert = None
+    if version >= 3 and r.boolean():
+        cert = _r_quorum_cert_body(r)
+    return SavedCommit(commit=commit, cert=cert)
 
 
 def _w_saved_new_view(w: _Writer, m: SavedNewView) -> None:
@@ -571,7 +737,11 @@ def _r_saved_view_change(r: _Reader, version: int) -> SavedViewChange:
 # Readers take (reader, envelope_version) — the WAL-record domain is
 # versioned independently of the wire messages so a record-layout change
 # cannot invalidate inter-replica traffic (and vice versa).
-_SAVED_VERSION = 2  # v2: ProposedRecord gained `verified` (v1 record => True)
+# v2: ProposedRecord gained `verified` (v1 record => True).
+# v3: half-aggregated quorum certs — SavedCommit gained an optional
+#     QuorumCert and ProposedRecord's nested PrePrepare is encoded at wire
+#     v2 so its prev-commit field can carry one.
+_SAVED_VERSION = 3
 
 _SAVED_CODECS: dict[int, tuple[type, Callable, Callable]] = {
     1: (ProposedRecord, _w_proposed_record, _r_proposed_record),
@@ -592,10 +762,18 @@ def _saved_version_for(msg: SavedMessage) -> int:
     a WAL it can decode — the crash-recovery pin must survive downgrades,
     not just upgrades.  Only the rare mid-verification crash window
     (``verified=False``) needs v2, and such a record is rewritten at the
-    next truncation anyway.
+    next truncation anyway.  Only cert_mode="half-agg" records actually
+    carrying a QuorumCert need v3, so full-mode WALs stay bit-for-bit the
+    seed encoding.
     """
-    if isinstance(msg, ProposedRecord) and not msg.verified:
-        return _SAVED_VERSION
+    if isinstance(msg, ProposedRecord):
+        if isinstance(msg.pre_prepare.prev_commit_signatures, QuorumCert):
+            return 3
+        if not msg.verified:
+            return 2
+        return 1
+    if isinstance(msg, SavedCommit) and msg.cert is not None:
+        return 3
     return 1
 
 
@@ -611,6 +789,8 @@ def encode_saved(msg: SavedMessage) -> bytes:
     w.u8(tag)
     if isinstance(msg, ProposedRecord):
         _w_proposed_record(w, msg, version)
+    elif isinstance(msg, SavedCommit):
+        _w_saved_commit(w, msg, version)
     else:
         _SAVED_CODECS[tag][1](w, msg)
     return w.getvalue()
@@ -647,4 +827,5 @@ __all__ = [
     "decode_view_metadata",
     "encode_saved",
     "decode_saved",
+    "encoded_cert_size",
 ]

@@ -16,7 +16,12 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional, Union
 
-from consensus_tpu.types import Proposal, Signature
+from consensus_tpu.types import Proposal, QuorumCert, Signature
+
+#: A commit-signature quorum as carried on the wire / in the WAL: either the
+#: full tuple of per-signer signatures (cert_mode="full", the seed encoding)
+#: or a half-aggregated QuorumCert (cert_mode="half-agg", codec v2).
+Cert = Union[tuple[Signature, ...], QuorumCert]
 
 
 @dataclass(frozen=True)
@@ -40,13 +45,15 @@ class PrePrepare:
 
     ``prev_commit_signatures`` carries the quorum that committed the previous
     proposal — followers verify them and the blacklist update they imply.
+    Under ``cert_mode="half-agg"`` it is a :class:`QuorumCert` instead of a
+    signature tuple (wire v2; verified in one aggregate check).
     Parity: reference smartbftprotos/messages.proto:29-34.
     """
 
     view: int
     seq: int
     proposal: Proposal
-    prev_commit_signatures: tuple[Signature, ...] = ()
+    prev_commit_signatures: Cert = ()
 
 
 @dataclass(frozen=True)
@@ -109,7 +116,7 @@ class ViewData:
 
     next_view: int
     last_decision: Optional[Proposal] = None
-    last_decision_signatures: tuple[Signature, ...] = ()
+    last_decision_signatures: Cert = ()
     in_flight_proposal: Optional[Proposal] = None
     in_flight_prepared: bool = False
 
@@ -208,7 +215,7 @@ class SyncChunk:
     from_seq: int
     height: int
     decisions: tuple[Proposal, ...] = ()
-    quorum_certs: tuple[tuple[Signature, ...], ...] = ()
+    quorum_certs: tuple[Cert, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -239,7 +246,11 @@ class EpochTagged:
 
 
 #: The "Message oneof": anything a replica may put on the wire.
+#: QuorumCert (types.py) is a member too: a half-aggregated cert travels
+#: standalone under codec tag 15 as well as embedded in PrePrepare /
+#: SyncChunk / ViewData / SavedCommit cert fields.
 ConsensusMessage = Union[
+    QuorumCert,
     PrePrepare,
     Prepare,
     Commit,
@@ -288,9 +299,14 @@ class SavedCommit:
     ``Message``; we store the ``Commit`` directly).
 
     Parity: reference smartbftprotos/messages.proto:113-116 (``commit`` arm).
+
+    ``cert`` (half-agg mode only, WAL v3) persists the assembled
+    :class:`QuorumCert` for the decided sequence so a restart can re-serve
+    the compact cert to sync clients and view changes without re-aggregating.
     """
 
     commit: Commit
+    cert: Optional[QuorumCert] = None
 
 
 @dataclass(frozen=True)
@@ -360,10 +376,14 @@ def msg_to_string(msg: ConsensusMessage) -> str:
         return f"<SyncSnapshotMeta height={msg.height} tip={msg.last_digest[:8]}>"
     if isinstance(msg, EpochTagged):
         return f"<EpochTagged epoch={msg.epoch} msg={msg_to_string(msg.msg)}>"
+    if isinstance(msg, QuorumCert):
+        return f"<QuorumCert n={len(msg)} signers={list(msg.signer_ids)}>"
     return repr(msg)
 
 
 __all__ = [
+    "Cert",
+    "QuorumCert",
     "ViewMetadata",
     "PrePrepare",
     "Prepare",

@@ -64,7 +64,11 @@ def run_sweep(args) -> int:
             seed, n=args.nodes, steps=args.steps,
             durability_window=args.window, churn=args.churn,
         )
-        result = ChaosEngine(schedule, obs=obs).run()
+        # cert_mode="half-agg" needs an aggregation-capable verifier, so it
+        # implies the real-crypto harness; "full" keeps the seed-identical
+        # trivial-crypto sweep.
+        crypto = "ed25519-halfagg" if args.cert_mode == "half-agg" else None
+        result = ChaosEngine(schedule, obs=obs, crypto=crypto).run()
         counts: dict[str, int] = {}
         for a in result.anomalies:
             counts[a.kind] = counts.get(a.kind, 0) + 1
@@ -73,6 +77,7 @@ def run_sweep(args) -> int:
             {
                 "seed": seed,
                 "ok": result.ok,
+                "cert_mode": args.cert_mode,
                 "anomalies": dict(sorted(counts.items())),
                 "health": result.final_health,
             },
@@ -110,6 +115,7 @@ def run_sweep(args) -> int:
             "steps": args.steps,
             "window": args.window,
             "churn": args.churn,
+            "cert_mode": args.cert_mode,
         },
     }
     line = json.dumps(summary, sort_keys=True)
@@ -134,6 +140,12 @@ def main() -> int:
     ap.add_argument("--churn", action="store_true",
                     help="add elastic-membership actions (add_node / "
                          "remove_node) to each schedule's vocabulary")
+    ap.add_argument("--cert-mode", choices=("full", "half-agg"),
+                    default="full",
+                    help='quorum-cert format: "half-agg" runs every seed '
+                         "under real Ed25519 with half-aggregated certs "
+                         '(Configuration.cert_mode); "full" is the '
+                         "seed-identical default")
     ap.add_argument("--sample-interval", type=float, default=5.0,
                     help="obs-plane sampling interval (sim seconds)")
     ap.add_argument("--shrink-on-failure", action="store_true",

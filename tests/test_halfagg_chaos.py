@@ -1,0 +1,105 @@
+"""Chaos gates for half-aggregated quorum certs (``crypto="ed25519-halfagg"``).
+
+Three pinned schedules:
+
+* **Same-seed parity** — one honest schedule run under ``ed25519`` (full
+  tuples) and ``ed25519-halfagg`` (compact certs) must produce IDENTICAL
+  ledgers and byte-identical event logs: compressing the cert format may
+  never change what gets ordered.  The half-agg byzantine arm rolls on the
+  crypto-only RNG stream only while a byzantine rule is armed, so honest
+  runs consume zero rolls and replay exactly.
+* **Byzantine component corruption** — a byzantine replica corrupts ONE
+  component signature inside an otherwise-valid quorum right before
+  aggregating it.  The aggregator's self-check catches it, bisection
+  localizes the bad component (strict-parity pinned in test_halfagg.py),
+  the node degrades to the full signature tuple, and every invariant
+  holds — compactness is a perf property, never a liveness dependency.
+* **verify_collapse stays silent** — aggregate certs do commit-path
+  verification work like any other cert, so the obs detector that hunts
+  for decisions-without-verification must not fire on an honest half-agg
+  run.
+"""
+
+from consensus_tpu.config import ObsConfig
+from consensus_tpu.testing.chaos import ChaosAction, ChaosEngine, ChaosSchedule
+from consensus_tpu.types import QuorumCert
+
+HONEST = ChaosSchedule(
+    seed=9021,
+    n=4,
+    actions=(
+        ChaosAction(at=35.0, kind="loss", args={"a": 1, "b": 3, "p": 0.2}),
+        ChaosAction(at=55.0, kind="delay", args={"a": 2, "b": 4, "d": 0.3}),
+        ChaosAction(at=80.0, kind="crash", args={"node": 3}),
+        ChaosAction(at=105.0, kind="restart", args={"node": 3}),
+        ChaosAction(at=125.0, kind="heal", args={}),
+    ),
+)
+
+
+def test_same_seed_chaos_parity_full_vs_halfagg():
+    full = ChaosEngine(HONEST, crypto="ed25519").run()
+    assert full.ok, full.violation
+    half = ChaosEngine(HONEST, crypto="ed25519-halfagg").run()
+    assert half.ok, half.violation
+    assert full.ledgers == half.ledgers
+    assert full.event_log == half.event_log
+    assert max(len(d) for d in full.ledgers.values()) >= 1
+
+
+def test_byzantine_component_corruption_falls_back_to_full_cert():
+    schedule = ChaosSchedule(
+        seed=77,
+        n=4,
+        actions=(
+            ChaosAction(at=35.0, kind="byzantine", args={"node": 4, "rate": 1.0}),
+            ChaosAction(at=60.0, kind="heal", args={}),
+            ChaosAction(at=85.0, kind="heal", args={}),
+            ChaosAction(at=110.0, kind="heal", args={}),
+            ChaosAction(at=135.0, kind="heal", args={}),
+            ChaosAction(at=160.0, kind="byzantine_stop", args={}),
+        ),
+    )
+    engine = ChaosEngine(schedule, crypto="ed25519-halfagg")
+    result = engine.run()
+    assert result.ok, result.violation
+
+    fallbacks = {
+        nid: node.app._verifier.aggregator.fallback_bisections
+        for nid, node in engine.cluster.nodes.items()
+    }
+    degraded = {
+        nid: sum(
+            1 for d in node.app.ledger
+            if not isinstance(d.signatures, QuorumCert)
+        )
+        for nid, node in engine.cluster.nodes.items()
+    }
+    # The armed replica's self-check caught the corrupted component (via
+    # the bisection localizer) and degraded exactly those decisions to the
+    # full signature tuple; honest replicas never fell back.
+    assert fallbacks[4] > 0, "the byzantine arm never tripped the self-check"
+    assert degraded[4] == fallbacks[4]
+    assert all(fallbacks[n] == 0 and degraded[n] == 0 for n in (1, 2, 3))
+    # Liveness and agreement survived the degradation.
+    assert max(len(d) for d in result.ledgers.values()) >= 3
+
+
+def test_verify_collapse_detector_silent_on_honest_halfagg_run():
+    obs = ObsConfig(enabled=True, sample_interval=5.0)
+    quiet = ChaosSchedule(
+        seed=9021,
+        n=4,
+        actions=(
+            ChaosAction(at=35.0, kind="loss", args={"a": 1, "b": 3, "p": 0.2}),
+            ChaosAction(at=55.0, kind="delay", args={"a": 2, "b": 4, "d": 0.3}),
+            ChaosAction(at=80.0, kind="heal", args={}),
+        ),
+    )
+    result = ChaosEngine(quiet, obs=obs, crypto="ed25519-halfagg").run()
+    assert result.ok, result.violation
+    collapse = [a for a in result.anomalies if a.kind == "verify_collapse"]
+    assert not collapse, (
+        "aggregate cert verification was invisible to the launch counters: "
+        f"{collapse}"
+    )

@@ -1,0 +1,257 @@
+"""Half-aggregated quorum certs threaded through the protocol: an
+end-to-end cluster running ``Configuration.cert_mode="half-agg"`` with
+real Ed25519, WAL restore of the cert-bearing SavedCommit twin, wire
+catch-up serving half-agg certs, catch-up over a ledger whose cert
+format flipped mid-history, the pinned cert byte counters, and the
+mixed-cert-mode contradiction guard on the multi-batch port.
+
+Runs on the aggregator's host big-int twin (``min_device_batch=10**9``
+engines) — the device-kernel side of the same surfaces is pinned in
+test_halfagg.py.
+"""
+
+import struct
+
+from consensus_tpu.metrics import (
+    CERT_AGGREGATE_LAUNCHES_KEY,
+    CERT_BYTES_PER_CERT_KEY,
+    CERT_FALLBACK_BISECTIONS_KEY,
+    NET_CERT_BYTES_KEY,
+    SYNC_CERT_BYTES_KEY,
+    WAL_CERT_BYTES_KEY,
+    InMemoryProvider,
+    Metrics,
+)
+from consensus_tpu.models import Ed25519BatchVerifier, Ed25519Signer, Ed25519VerifierMixin
+from consensus_tpu.sync import (
+    InProcessSyncTransport,
+    LedgerDecisionStore,
+    LedgerSynchronizer,
+    SyncServer,
+)
+from consensus_tpu.testing import Cluster, make_request, pack_batch
+from consensus_tpu.testing.crypto_app import CryptoApp
+from consensus_tpu.types import Decision, Proposal, QuorumCert
+from consensus_tpu.wire import SavedCommit, ViewMetadata, encode_view_metadata
+from consensus_tpu.wire.codec import decode_saved
+
+
+class _SigVerifier(Ed25519VerifierMixin):
+    def verify_proposal(self, proposal):
+        raise NotImplementedError  # app half lives in CryptoApp
+
+    def verify_request(self, raw):
+        raise NotImplementedError
+
+    def verification_sequence(self):
+        return 0
+
+    def requests_from_proposal(self, proposal):
+        return []
+
+
+def _halfagg_cluster(n=4, *, seed=0, cert_mode="half-agg"):
+    tweaks = {} if cert_mode is None else {"cert_mode": cert_mode}
+    cluster = Cluster(n, seed=seed, config_tweaks=tweaks)
+    engine = Ed25519BatchVerifier(min_device_batch=10**9)  # host twin
+    signers = {i: Ed25519Signer(i, bytes([i + 1]) * 32) for i in cluster.nodes}
+    keys = {i: s.public_bytes for i, s in signers.items()}
+    for node_id, node in cluster.nodes.items():
+        node.metrics = Metrics(InMemoryProvider())
+        node.app = CryptoApp(
+            node_id, cluster, signers[node_id], _SigVerifier(keys, engine=engine)
+        )
+    return cluster
+
+
+def test_halfagg_cluster_orders_with_aggregate_certs():
+    cluster = _halfagg_cluster()
+    cluster.start()
+    for i in range(3):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, max_time=300.0), f"block {i} stalled"
+    cluster.assert_ledgers_consistent()
+
+    for node in cluster.nodes.values():
+        for decision in node.app.ledger:
+            cert = decision.signatures
+            assert isinstance(cert, QuorumCert), "half-agg mode must decide certs"
+            assert len(set(cert.signer_ids)) >= 3
+            assert node.app.verify_aggregate_cert(cert, decision.proposal) is not None
+
+    # Pinned accounting on the leader: every decide aggregated (one MSM
+    # check each), WAL'd the compact twin, and broadcast cert bytes in the
+    # next pre-prepare; the self-check never fell back.
+    p = cluster.nodes[1].metrics.provider
+    assert p.value(CERT_AGGREGATE_LAUNCHES_KEY) >= 3
+    assert p.value(WAL_CERT_BYTES_KEY) > 0
+    assert p.value(NET_CERT_BYTES_KEY) > 0
+    assert p.observations(CERT_BYTES_PER_CERT_KEY)
+    assert p.value(CERT_FALLBACK_BISECTIONS_KEY) == 0
+
+
+def test_full_mode_stays_tuple_and_counts_nothing():
+    cluster = _halfagg_cluster(cert_mode=None)
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+    for node in cluster.nodes.values():
+        for decision in node.app.ledger:
+            assert not isinstance(decision.signatures, QuorumCert)
+        p = node.metrics.provider
+        assert p.value(CERT_AGGREGATE_LAUNCHES_KEY) == 0
+        assert p.value(WAL_CERT_BYTES_KEY) == 0
+        assert p.value(NET_CERT_BYTES_KEY) == 0
+
+
+def test_halfagg_saved_commit_survives_wal_restart():
+    """The decide-time SavedCommit twin (cert attached, saved v3) must be
+    on disk and the node must restart cleanly from a WAL containing it."""
+    cluster = _halfagg_cluster()
+    cluster.start()
+    for i in range(2):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, max_time=300.0)
+
+    node = cluster.nodes[2]
+    cert_records = [
+        rec for rec in (decode_saved(e) for e in node.wal_backing)
+        if isinstance(rec, SavedCommit) and rec.cert is not None
+    ]
+    assert cert_records, "no cert-bearing SavedCommit twin reached the WAL"
+    for rec in cert_records:
+        assert isinstance(rec.cert, QuorumCert)
+        assert len(set(rec.cert.signer_ids)) >= 3
+
+    node.restart()
+    cluster.submit_to_all(make_request("c", 2))
+    assert cluster.run_until_ledger(3, max_time=300.0), (
+        "restart from a v3 cert-bearing WAL wedged the node"
+    )
+    cluster.assert_ledgers_consistent()
+
+
+def test_crashed_node_catches_up_over_halfagg_certs():
+    """Wire catch-up in half-agg mode: the sync server serves QuorumCerts,
+    the client verifies them through the aggregate path (one MSM check per
+    cert) and accounts the synced cert bytes."""
+    cluster = _halfagg_cluster()
+    cluster.start()
+    cluster.submit_to_all(make_request("c", 0))
+    assert cluster.run_until_ledger(1, max_time=300.0)
+
+    cluster.nodes[4].crash()
+    for i in range(1, 3):
+        cluster.submit_to_all(make_request("c", i))
+        assert cluster.run_until_ledger(i + 1, node_ids=[1, 2, 3], max_time=300.0)
+
+    cluster.nodes[4].start()
+    assert cluster.run_until_ledger(3, max_time=600.0), "catch-up stalled"
+    cluster.assert_ledgers_consistent()
+    synced = cluster.nodes[4].app.ledger
+    assert all(isinstance(d.signatures, QuorumCert) for d in synced)
+    assert cluster.nodes[4].metrics.provider.value(SYNC_CERT_BYTES_KEY) > 0
+
+
+# --- catch-up over a ledger with BOTH cert formats -------------------------
+
+
+def _signed_chain(length, signers, keys, engine, *, halfagg_from):
+    """A decision chain whose cert format flips mid-history (the shape a
+    ledger has after ``cert_mode`` changed at a membership epoch boundary):
+    positions < halfagg_from carry full signature tuples, the rest carry
+    half-aggregated QuorumCerts built from the same signatures."""
+    verifier = _SigVerifier(keys, engine=engine)
+    chain = []
+    for seq in range(1, length + 1):
+        proposal = Proposal(
+            payload=pack_batch([make_request("chain", seq)]),
+            header=struct.pack(">Q", seq - 1),
+            metadata=encode_view_metadata(
+                ViewMetadata(view_id=0, latest_sequence=seq, decisions_in_view=seq)
+            ),
+        )
+        sigs = tuple(
+            signers[i].sign_proposal(proposal, b"aux") for i in (1, 3, 4)
+        )
+        if seq >= halfagg_from:
+            cert = verifier.aggregate_cert(proposal, sigs)
+            assert cert is not None
+            chain.append(Decision(proposal=proposal, signatures=cert))
+        else:
+            chain.append(Decision(proposal=proposal, signatures=sigs))
+    return chain
+
+
+class _CountingVerifier:
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls = 0
+        self.kinds = []
+
+    def verify_consenter_sigs_multi_batch(self, groups):
+        self.calls += 1
+        self.kinds.append({isinstance(c, QuorumCert) for _, c in groups})
+        return self.inner.verify_consenter_sigs_multi_batch(groups)
+
+
+class _OpenNetwork:
+    def node_ids(self):
+        return [1, 2, 3, 4]
+
+    def reachable(self, a, b):
+        return True
+
+
+def test_sync_catchup_over_mixed_cert_format_ledger():
+    engine = Ed25519BatchVerifier(min_device_batch=10**9)
+    signers = {i: Ed25519Signer(i, bytes([i + 1]) * 32) for i in (1, 2, 3, 4)}
+    keys = {i: s.public_bytes for i, s in signers.items()}
+    chain = _signed_chain(12, signers, keys, engine, halfagg_from=7)
+
+    servers = {p: SyncServer(LedgerDecisionStore(list(chain))) for p in (1, 3, 4)}
+    transport = InProcessSyncTransport(2, _OpenNetwork(), servers)
+    counting = _CountingVerifier(_SigVerifier(keys, engine=engine))
+    provider = InMemoryProvider()
+    ledger = []
+    client = LedgerSynchronizer(
+        node_id=2,
+        store=LedgerDecisionStore(ledger),
+        transport=transport,
+        verifier=counting,
+        nodes=(1, 2, 3, 4),
+        metrics=Metrics(provider).sync,
+    )
+    response = client.sync()
+
+    assert len(ledger) == 12
+    assert [d.proposal.digest() for d in ledger] == [
+        d.proposal.digest() for d in chain
+    ]
+    # Formats survive the round trip: the pre-flip era stays full tuples,
+    # the post-flip era stays compact.
+    assert all(not isinstance(d.signatures, QuorumCert) for d in ledger[:6])
+    assert all(isinstance(d.signatures, QuorumCert) for d in ledger[6:])
+    assert response.latest.proposal.digest() == chain[-1].proposal.digest()
+    # One chunk (12 < window), partitioned into one homogeneous multi-batch
+    # call per cert format — never a mixed group.
+    assert counting.calls == 2
+    assert all(len(k) == 1 for k in counting.kinds)
+    assert provider.value(SYNC_CERT_BYTES_KEY) > 0
+
+
+def test_multi_batch_rejects_mixed_cert_modes():
+    import pytest
+
+    engine = Ed25519BatchVerifier(min_device_batch=10**9)
+    signers = {i: Ed25519Signer(i, bytes([i + 1]) * 32) for i in (1, 2, 3, 4)}
+    keys = {i: s.public_bytes for i, s in signers.items()}
+    verifier = _SigVerifier(keys, engine=engine)
+    proposal = Proposal(payload=b"x")
+    sigs = tuple(signers[i].sign_proposal(proposal, b"") for i in (1, 2, 3))
+    cert = verifier.aggregate_cert(proposal, sigs)
+    assert cert is not None
+    with pytest.raises(ValueError, match="contradict"):
+        verifier.verify_consenter_sigs_multi_batch(
+            [(proposal, sigs), (proposal, cert)]
+        )

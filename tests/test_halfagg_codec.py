@@ -1,0 +1,213 @@
+"""Wire/WAL codec coverage for half-aggregated quorum certs: the
+standalone tag-15 message, the v2 cert-carrying envelopes (PrePrepare,
+SyncChunk, ViewData), the v3 SavedCommit WAL record, malformed-cert
+rejection, the lowest-lossless-version rule (cert_mode="full" traffic
+stays bit-for-bit v1), and the ISSUE acceptance bar: half-agg cert bytes
+<= 0.55x the full signature tuple at n=16 on the wire, WAL, and
+sync-chunk paths.
+
+Kept separate from test_wire.py, which needs the ``cryptography`` package
+for its signing fixtures; nothing here does.
+"""
+
+import pytest
+
+from consensus_tpu.types import Proposal, QuorumCert, Signature
+from consensus_tpu.wire import (
+    Commit,
+    PreparesFrom,
+    PrePrepare,
+    Prepare,
+    ProposedRecord,
+    SavedCommit,
+    SyncChunk,
+    ViewData,
+)
+from consensus_tpu.wire.codec import (
+    CodecError,
+    decode_message,
+    decode_saved,
+    decode_view_data,
+    encode_message,
+    encode_prepares_from,
+    encode_saved,
+    encode_view_data,
+    encoded_cert_size,
+)
+
+N = 16  # the quorum size the byte-ratio acceptance bar is pinned at
+
+
+def make_cert(n=N, aux=None):
+    """A structurally-valid QuorumCert and its full-tuple twin, carrying
+    the aux payload commit signatures actually ride (the prepare-sender
+    voter list), identical across signers so the cert dedups it."""
+    if aux is None:
+        aux = encode_prepares_from(PreparesFrom(ids=tuple(range(1, n + 1))))
+    full = tuple(
+        Signature(id=i + 1, value=bytes([i + 1]) * 64, msg=aux)
+        for i in range(n)
+    )
+    half = QuorumCert(
+        signer_ids=tuple(range(1, n + 1)),
+        rs=tuple(bytes([i + 1]) * 32 for i in range(n)),
+        s_agg=bytes(32),
+        aux_table=(aux,),
+        aux_index=(0,) * n,
+    )
+    return full, half
+
+
+PROPOSAL = Proposal(payload=b"p", header=b"h", metadata=b"m")
+
+
+def test_standalone_quorum_cert_round_trips_as_tag_15():
+    _, half = make_cert(4)
+    buf = encode_message(half)
+    assert buf[0] == 2  # a cert on the wire is inherently v2
+    assert buf[2] == 15
+    assert decode_message(buf) == half
+
+
+def test_pre_prepare_with_cert_rides_v2_and_round_trips():
+    full, half = make_cert(4)
+    for cert in (half, full):
+        pp = PrePrepare(
+            view=1, seq=2, proposal=PROPOSAL, prev_commit_signatures=cert
+        )
+        buf = encode_message(pp)
+        assert buf[0] == (2 if isinstance(cert, QuorumCert) else 1)
+        assert decode_message(buf) == pp
+
+
+def test_sync_chunk_mixes_cert_formats_on_v2():
+    """A catch-up chunk from a ledger whose cert_mode flipped mid-history
+    carries BOTH formats; one QuorumCert anywhere lifts the chunk to v2."""
+    full, half = make_cert(4)
+    chunk = SyncChunk(
+        from_seq=1, height=2,
+        decisions=(PROPOSAL, PROPOSAL),
+        quorum_certs=(full, half),
+    )
+    buf = encode_message(chunk)
+    assert buf[0] == 2
+    decoded = decode_message(buf)
+    assert decoded == chunk
+    assert isinstance(decoded.quorum_certs[0], tuple)
+    assert isinstance(decoded.quorum_certs[1], QuorumCert)
+
+
+def test_view_data_cert_proof_round_trips():
+    full, half = make_cert(4)
+    for cert in (half, full):
+        vd = ViewData(
+            next_view=3, last_decision=PROPOSAL,
+            last_decision_signatures=cert,
+        )
+        buf = encode_view_data(vd)
+        assert buf[0] == (2 if isinstance(cert, QuorumCert) else 1)
+        assert decode_view_data(buf) == vd
+
+
+def test_saved_commit_cert_needs_v3_and_round_trips():
+    _, half = make_cert(4)
+    commit = Commit(view=0, seq=1, digest="d", signature=Signature(id=1))
+    with_cert = SavedCommit(commit=commit, cert=half)
+    buf = encode_saved(with_cert)
+    assert buf[0] == 3
+    assert decode_saved(buf) == with_cert
+    # Cert-free records keep their seed version: full-mode WALs are
+    # bit-for-bit unchanged by the half-agg feature existing.
+    plain = encode_saved(SavedCommit(commit=commit))
+    assert plain[0] < 3
+    assert decode_saved(plain) == SavedCommit(commit=commit)
+
+
+def test_full_mode_wire_stays_bit_for_bit_v1():
+    full, _ = make_cert(4)
+    pp = PrePrepare(view=0, seq=1, proposal=PROPOSAL,
+                    prev_commit_signatures=full)
+    chunk = SyncChunk(from_seq=1, height=1, decisions=(PROPOSAL,),
+                      quorum_certs=(full,))
+    for msg in (pp, chunk):
+        assert encode_message(msg)[0] == 1
+
+
+def test_malformed_cert_bodies_rejected():
+    _, half = make_cert(4)
+    buf = bytearray(encode_message(half))
+    buf[3] = 7  # cert bodies are length-framed fields; corrupt the first
+    with pytest.raises(CodecError):
+        decode_message(bytes(buf))
+    with pytest.raises(CodecError):
+        decode_message(encode_message(half)[:-3])  # truncated body
+    # Parallel-field length mismatch refuses to even encode.
+    with pytest.raises(CodecError):
+        encode_message(
+            QuorumCert(signer_ids=(1, 2), rs=(b"\x00" * 32,),
+                       s_agg=bytes(32), aux_table=(b"",), aux_index=(0, 0))
+        )
+    # aux_index out of range is caught at decode time.
+    bad = QuorumCert(signer_ids=(1,), rs=(b"\x00" * 32,), s_agg=bytes(32),
+                     aux_table=(b"",), aux_index=(3,))
+    with pytest.raises(CodecError):
+        decode_message(encode_message(bad))
+
+
+# --- the 0.55x byte acceptance bar at n=16 ---------------------------------
+
+
+def test_cert_field_bytes_at_most_055x_full_at_n16():
+    full, half = make_cert(N)
+    assert encoded_cert_size(half) <= 0.55 * encoded_cert_size(full)
+
+
+def _carrier_delta(build):
+    """Cert-byte contribution to a carrier: encoded size with the cert
+    minus the size with an empty cert — isolates the cert payload from
+    the unrelated message framing."""
+    return len(build(make_cert(N)[0])) - len(build(())), \
+        len(build(make_cert(N)[1])) - len(build(()))
+
+
+def test_wire_pre_prepare_cert_bytes_at_most_055x():
+    def build(cert):
+        return encode_message(PrePrepare(
+            view=0, seq=1, proposal=PROPOSAL, prev_commit_signatures=cert
+        ))
+
+    full_delta, half_delta = _carrier_delta(build)
+    assert half_delta <= 0.55 * full_delta
+
+
+def test_wal_proposed_record_cert_bytes_at_most_055x():
+    def build(cert):
+        return encode_saved(ProposedRecord(
+            pre_prepare=PrePrepare(view=0, seq=1, proposal=PROPOSAL,
+                                   prev_commit_signatures=cert),
+            prepare=Prepare(view=0, seq=1, digest="d"),
+        ))
+
+    full_delta, half_delta = _carrier_delta(build)
+    assert half_delta <= 0.55 * full_delta
+
+
+def test_sync_chunk_cert_bytes_at_most_055x():
+    def build(cert):
+        return encode_message(SyncChunk(
+            from_seq=1, height=1, decisions=(PROPOSAL,),
+            quorum_certs=(cert,),
+        ))
+
+    full_delta, half_delta = _carrier_delta(build)
+    assert half_delta <= 0.55 * full_delta
+
+
+def test_wal_saved_commit_cert_cheaper_than_full_tuple_wire():
+    """The cert-bearing SavedCommit twin (decide-time WAL record) must
+    cost less than 0.55x what persisting the full tuple would."""
+    full, half = make_cert(N)
+    commit = Commit(view=0, seq=1, digest="d", signature=Signature(id=1))
+    base = len(encode_saved(SavedCommit(commit=commit)))
+    with_cert = len(encode_saved(SavedCommit(commit=commit, cert=half)))
+    assert with_cert - base <= 0.55 * encoded_cert_size(full)

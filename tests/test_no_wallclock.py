@@ -73,3 +73,23 @@ def test_lint_honors_wallclock_ok_marker(tmp_path):
         text=True,
     )
     assert proc.returncode == 0, proc.stdout
+
+
+def test_lint_covers_models_aggregate():
+    """Half-aggregation (models/aggregate.py) derives its Fiat-Shamir
+    coefficients from a deterministic transcript — a wall-clock read
+    anywhere in the models/ tree would let two replicas derive different
+    coefficients for the same quorum and split on cert validity.  Pin the
+    lint's coverage of the crypto model tree and the aggregate module's
+    presence, independently of the package-wide walk."""
+    models_dir = os.path.join(_REPO, "consensus_tpu", "models")
+    present = {f for f in os.listdir(models_dir) if f.endswith(".py")}
+    assert {"aggregate.py", "ed25519.py", "verifier.py"} <= present
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, models_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        "crypto models have wall-clock reads:\n" + proc.stdout + proc.stderr
+    )
