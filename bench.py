@@ -45,8 +45,15 @@ LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: (a run killed mid-retry loses the last_good trail entirely): a hung
 #: probe burns its full 90 s timeout, so 120 s means one hung probe + stop,
 #: while fast-failing probes (connection refused) get several retries.
-#: Override with CTPU_BENCH_RETRY_WINDOW (seconds); 0 disables retries.
-RETRY_WINDOW = float(os.environ.get("CTPU_BENCH_RETRY_WINDOW", "120"))
+#: Override with CTPU_BENCH_RETRY_S (seconds; 0 disables retries).  The
+#: older CTPU_BENCH_RETRY_WINDOW spelling is honored as a fallback so
+#: existing CI lane configs keep working.
+RETRY_WINDOW = float(
+    os.environ.get(
+        "CTPU_BENCH_RETRY_S",
+        os.environ.get("CTPU_BENCH_RETRY_WINDOW", "120"),
+    )
+)
 PROBE_TIMEOUT = 90.0
 
 
@@ -135,6 +142,74 @@ def bench_batch_verify(msgs, sigs, keys) -> float:
         ok = verifier.verify_batch(msgs, sigs, keys)
         assert ok.all()
     return len(msgs) * DEVICE_ITERS / (time.perf_counter() - start)
+
+
+def bench_fused_verify(msgs, sigs, keys) -> float:
+    """``fused_verify`` column: the bytes-in → verdict-out engine
+    (models/fused.py) timed through ``verify_stream`` so host byte-slicing
+    of wave i+1 overlaps device execution of wave i (the engine's own
+    double-buffering, the fused twin of ``_pipelined_rate``).  Host prep
+    here is only SHA-512 block layout — hashing, mod-L reduction, range
+    checks, and digit recoding all ride inside the launch."""
+    from consensus_tpu.models.fused import FusedEd25519BatchVerifier
+
+    verifier = FusedEd25519BatchVerifier()
+    ok = verifier.verify_batch(msgs, sigs, keys)  # warmup: compiles the graph
+    assert ok.all(), "benchmark signatures must verify"
+    waves = [(msgs, sigs, keys)] * DEVICE_ITERS
+    start = time.perf_counter()
+    for ok in verifier.verify_stream(waves):
+        assert ok.all()
+    return len(msgs) * DEVICE_ITERS / (time.perf_counter() - start)
+
+
+def bench_prep_breakdown(msgs, sigs, keys) -> dict:
+    """host_prep_ms vs kernel_ms split for the ed25519_verify family: where
+    does a strict wave actually spend its time, and how much of the host
+    tax does the fused engine delete?  The legacy kernel is timed over
+    ``DEVICE_ITERS`` re-launches on resident buffers; the fused graph
+    donates its input buffers, so its kernel time is a single fresh-wave
+    launch (re-launching a donated graph on consumed buffers is an error)."""
+    import jax
+
+    from consensus_tpu.models import Ed25519BatchVerifier
+    from consensus_tpu.models.ed25519 import _verify_kernel, to_kernel_layout
+    from consensus_tpu.models.fused import (
+        FusedEd25519BatchVerifier,
+        _fused_verify_kernel,
+    )
+
+    verifier = Ed25519BatchVerifier()
+    assert verifier.verify_batch(msgs, sigs, keys).all()  # warmup
+    start = time.perf_counter()
+    args = to_kernel_layout(*verifier._prepare(msgs, sigs, keys))
+    host_prep_ms = (time.perf_counter() - start) * 1e3
+    args = jax.device_put(args)
+    jax.block_until_ready(_verify_kernel(*args))
+    start = time.perf_counter()
+    for _ in range(DEVICE_ITERS):
+        out = _verify_kernel(*args)
+    jax.block_until_ready(out)
+    kernel_ms = (time.perf_counter() - start) * 1e3 / DEVICE_ITERS
+
+    fused = FusedEd25519BatchVerifier()
+    assert fused.verify_batch(msgs, sigs, keys).all()  # warmup: compiles
+    start = time.perf_counter()
+    fused_args = fused._device_args(msgs, sigs, keys)
+    fused_prep_ms = (time.perf_counter() - start) * 1e3
+    start = time.perf_counter()
+    jax.block_until_ready(_fused_verify_kernel()(*fused_args))
+    fused_kernel_ms = (time.perf_counter() - start) * 1e3
+    return {
+        "source": "live",
+        "batch": len(msgs),
+        "host_prep_ms": round(host_prep_ms, 3),
+        "kernel_ms": round(kernel_ms, 3),
+        "fused": {
+            "host_prep_ms": round(fused_prep_ms, 3),
+            "kernel_ms": round(fused_kernel_ms, 3),
+        },
+    }
 
 
 #: shards × batch sweep for the mesh_verify column family.  Shard counts
@@ -341,9 +416,11 @@ def bench_cert_verify() -> tuple[float, float, dict]:
 #: the probe a second compile, so its trajectory is only recorded on live
 #: ``cert_verify`` runs.)
 _KERNEL_PROBE_CODE = """\
-import json
+import json, time
+import jax
 from consensus_tpu.models import Ed25519Signer
-from consensus_tpu.models.ed25519 import Ed25519BatchVerifier
+from consensus_tpu.models.ed25519 import (
+    Ed25519BatchVerifier, _verify_kernel, to_kernel_layout)
 from consensus_tpu.obs.kernels import KERNELS
 signer = Ed25519Signer(1, bytes([7]) * 32)
 msgs = [b"probe-%d" % i for i in range(8)]
@@ -352,7 +429,18 @@ keys = [signer.public_bytes] * 8
 v = Ed25519BatchVerifier(min_device_batch=1)
 assert v.verify_batch(msgs, sigs, keys).all()
 v.verify_batch(msgs, sigs, keys)
-print(json.dumps(KERNELS.snapshot()))
+start = time.perf_counter()
+args = to_kernel_layout(*v._prepare(msgs, sigs, keys))
+prep_ms = (time.perf_counter() - start) * 1e3
+start = time.perf_counter()
+jax.block_until_ready(_verify_kernel(*args))
+kernel_ms = (time.perf_counter() - start) * 1e3
+print(json.dumps({
+    "per_kernel": KERNELS.snapshot(),
+    "breakdown": {"batch": len(msgs),
+                  "host_prep_ms": round(prep_ms, 3),
+                  "kernel_ms": round(kernel_ms, 3)},
+}))
 """
 
 
@@ -370,9 +458,12 @@ def _kernel_accounting(source: str, per_kernel: dict) -> dict:
 
 
 def _probe_kernel_accounting(timeout: float = PROBE_TIMEOUT):
-    """Kernel column family for the structured-skip path: run the tiny CPU
-    probe in a subprocess (JAX_PLATFORMS=cpu — no tunnel involved) and
-    return the accounting record, or None when even CPU jax is broken."""
+    """Kernel + breakdown column families for the structured-skip path: run
+    the tiny CPU probe in a subprocess (JAX_PLATFORMS=cpu — no tunnel
+    involved) and return ``(accounting, breakdown)``, or ``(None, None)``
+    when even CPU jax is broken.  The breakdown keeps the host_prep_ms /
+    kernel_ms schema alive on skip records (probe-sized batch, so the
+    numbers gauge shape, not throughput)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         proc = subprocess.run(
@@ -381,11 +472,14 @@ def _probe_kernel_accounting(timeout: float = PROBE_TIMEOUT):
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
         if proc.returncode != 0:
-            return None
-        per_kernel = json.loads(proc.stdout.strip().splitlines()[-1])
+            return None, None
+        parsed = json.loads(proc.stdout.strip().splitlines()[-1])
     except (subprocess.TimeoutExpired, OSError, ValueError, IndexError):
-        return None
-    return _kernel_accounting("cpu-probe", per_kernel)
+        return None, None
+    breakdown = parsed.get("breakdown")
+    if breakdown is not None:
+        breakdown = dict(breakdown, source="cpu-probe")
+    return _kernel_accounting("cpu-probe", parsed["per_kernel"]), breakdown
 
 
 def _probe_device_once(timeout: float = PROBE_TIMEOUT) -> bool:
@@ -409,18 +503,20 @@ def _probe_device_once(timeout: float = PROBE_TIMEOUT) -> bool:
         return False
 
 
-def _probe_device_with_retries(window: float = RETRY_WINDOW) -> bool:
+def _probe_device_with_retries(window: float = RETRY_WINDOW):
     """Retry probes across the run window with a linear backoff; the tunnel
-    often returns within minutes."""
+    often returns within minutes.  Returns ``(ok, attempts)`` — the attempt
+    count lands in the structured-skip record so a harness can distinguish
+    "one hung probe ate the window" from "the tunnel refused N times"."""
     deadline = time.monotonic() + window
     attempt = 0
     while True:
-        if _probe_device_once():
-            return True
         attempt += 1
+        if _probe_device_once():
+            return True, attempt
         delay = min(30.0 * attempt, 120.0)
         if time.monotonic() + delay >= deadline:
-            return False
+            return False, attempt
         print(
             f"# device probe {attempt} failed; retrying in {delay:.0f}s "
             f"({deadline - time.monotonic():.0f}s left in window)",
@@ -481,7 +577,8 @@ def main() -> None:
         # its own key — it must never overwrite the headline last-good
         # number with an A/B experiment's result.
         metric += "_pallas"
-    if not _probe_device_with_retries():
+    probe_ok, probe_attempts = _probe_device_with_retries()
+    if not probe_ok:
         # A wedged TPU tunnel is an infrastructure condition, not a
         # benchmark failure: emit a MACHINE-READABLE skip record carrying
         # the last good measurement (marked stale=true so a harness never
@@ -494,6 +591,7 @@ def main() -> None:
             "skipped": "device-unavailable",
             "detail": "device unreachable (TPU tunnel wedged; "
                       f"retried for {RETRY_WINDOW:.0f}s)",
+            "attempts": probe_attempts,
             "last_good": dict(last_good, stale=True) if last_good else None,
         }
         if metric == "ed25519_verify_throughput":
@@ -509,7 +607,14 @@ def main() -> None:
                 "skipped": "device-unavailable",
                 "last_good": dict(mesh_last, stale=True) if mesh_last else None,
             }
-        record["kernels"] = _probe_kernel_accounting()
+            fused_last = _load_last_good("ed25519_fused_verify_throughput")
+            record["fused_verify"] = {
+                "skipped": "device-unavailable",
+                "last_good": (
+                    dict(fused_last, stale=True) if fused_last else None
+                ),
+            }
+        record["kernels"], record["breakdown"] = _probe_kernel_accounting()
         print(json.dumps(record))
         sys.exit(0)
 
@@ -517,6 +622,8 @@ def main() -> None:
 
     backend = jax.default_backend()
     batch_verify_rate = None
+    fused_verify_rate = None
+    breakdown_record = None
     mesh_record = None
     cert_bytes_record = None
     if metric == "cert_verify_throughput":
@@ -529,6 +636,13 @@ def main() -> None:
         device_rate = bench_device(msgs, sigs, keys)
         host_rate = bench_host(msgs, sigs, keys)
         if metric == "ed25519_verify_throughput":
+            breakdown_record = bench_prep_breakdown(msgs, sigs, keys)
+            fused_verify_rate = bench_fused_verify(msgs, sigs, keys)
+            _save_last_good(
+                "ed25519_fused_verify_throughput",
+                fused_verify_rate,
+                fused_verify_rate / device_rate,
+            )
             batch_verify_rate = bench_batch_verify(msgs, sigs, keys)
             _save_last_good(
                 "ed25519_batch_verify_throughput",
@@ -554,6 +668,14 @@ def main() -> None:
             "unit": "sigs/sec",
             "vs_strict": round(batch_verify_rate / device_rate, 3),
         }
+    if fused_verify_rate is not None:
+        record["fused_verify"] = {
+            "value": round(fused_verify_rate, 1),
+            "unit": "sigs/sec",
+            "vs_strict": round(fused_verify_rate / device_rate, 3),
+        }
+    if breakdown_record is not None:
+        record["breakdown"] = breakdown_record
     if mesh_record is not None:
         record["mesh_verify"] = mesh_record
     if cert_bytes_record is not None:
@@ -568,6 +690,11 @@ def main() -> None:
         + (
             f" batch-verify={batch_verify_rate:.0f}/s"
             if batch_verify_rate is not None
+            else ""
+        )
+        + (
+            f" fused-verify={fused_verify_rate:.0f}/s"
+            if fused_verify_rate is not None
             else ""
         )
         + (

@@ -141,6 +141,15 @@ class Configuration:
     # by a full-mode replica's strict path and vice versa — the
     # multi-batch contradiction guard fails loud on mixed groups).
     cert_mode: str = "full"
+    # Whole-pipeline-on-device verification (models/fused.py): the engine's
+    # host prep (SHA-512 challenge hashing, mod-L reduction, canonical-range
+    # checks, digit recoding) moves into the verify launch itself — the host
+    # only slices bytes into SHA-512 block layout.  Verdicts are bit-identical
+    # to the host-prep engines on every accept/reject class (SAFETY.md §10),
+    # so like mesh_shards this knob changes only WHERE the work runs, never
+    # the verdict — replicas in a cluster may differ freely.  Ed25519-only
+    # (engine_for_config rejects device_prep with the p256 curve).
+    device_prep: bool = False
     # Device-mesh width for the batch engine (parallel/sharding.py): 1 keeps
     # today's single-device engines bit-for-bit; >1 selects the sharded
     # engines (shard_map over a 1-D mesh, batch axis partitioned, validity

@@ -7,6 +7,10 @@ from consensus_tpu.models.ed25519 import (
     L,
 )
 from consensus_tpu.models.engine import BatchCoalescer, ThreadCoalescingVerifier
+from consensus_tpu.models.fused import (
+    FusedEd25519BatchVerifier,
+    FusedEd25519RandomizedBatchVerifier,
+)
 from consensus_tpu.models.verifier import (
     EcdsaP256Signer,
     EcdsaP256VerifierMixin,
@@ -23,6 +27,8 @@ __all__ = [
     "EcdsaP256VerifierMixin",
     "Ed25519BatchVerifier",
     "Ed25519RandomizedBatchVerifier",
+    "FusedEd25519BatchVerifier",
+    "FusedEd25519RandomizedBatchVerifier",
     "L",
     "BatchCoalescer",
     "ThreadCoalescingVerifier",

@@ -49,14 +49,17 @@ def raw_message(data: bytes) -> bytes:
 def engine_for_config(config, curve: str = "ed25519"):
     """The batch engine matching a ``Configuration``'s crypto knobs
     (``batch_verify_mode``, ``crypto_pad_pow2``, ``crypto_tpu_min_batch``,
-    ``mesh_shards``).  ``mesh_shards > 1`` selects the sharded engines from
-    :mod:`consensus_tpu.parallel` over a mesh of that many devices;
-    ``mesh_shards = 1`` returns today's single-device engines bit-for-bit.
-    Every replica in a cluster must agree on the VERDICT-affecting knobs
+    ``mesh_shards``, ``device_prep``).  ``mesh_shards > 1`` selects the
+    sharded engines from :mod:`consensus_tpu.parallel` over a mesh of that
+    many devices; ``mesh_shards = 1`` returns today's single-device engines
+    bit-for-bit.  ``device_prep`` swaps in the fused bytes-in → verdict-out
+    engines (:mod:`consensus_tpu.models.fused`) on either topology.  Every
+    replica in a cluster must agree on the VERDICT-affecting knobs
     (``batch_verify_mode``, the curve) — verdict parity across replicas is
-    a quorum-safety requirement; ``mesh_shards`` only changes the launch
-    topology and may differ per replica."""
+    a quorum-safety requirement; ``mesh_shards`` and ``device_prep`` only
+    change the launch topology and may differ per replica."""
     randomized = bool(getattr(config, "batch_verify_mode", False))
+    fused = bool(getattr(config, "device_prep", False))
     shards = int(getattr(config, "mesh_shards", 1) or 1)
     kw = dict(
         pad_pow2=config.crypto_pad_pow2,
@@ -66,6 +69,10 @@ def engine_for_config(config, curve: str = "ed25519"):
         if randomized:
             raise ValueError(
                 "batch_verify_mode is Ed25519-only (no randomized P-256 lane)"
+            )
+        if fused:
+            raise ValueError(
+                "device_prep is Ed25519-only (no fused P-256 front-end)"
             )
         from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
 
@@ -83,11 +90,36 @@ def engine_for_config(config, curve: str = "ed25519"):
         from consensus_tpu.parallel import (
             ShardedEd25519RandomizedVerifier,
             ShardedEd25519Verifier,
+            ShardedFusedEd25519RandomizedVerifier,
+            ShardedFusedEd25519Verifier,
             mesh_for_shards,
         )
 
-        cls = ShardedEd25519RandomizedVerifier if randomized else ShardedEd25519Verifier
+        if fused:
+            cls = (
+                ShardedFusedEd25519RandomizedVerifier
+                if randomized
+                else ShardedFusedEd25519Verifier
+            )
+        else:
+            cls = (
+                ShardedEd25519RandomizedVerifier
+                if randomized
+                else ShardedEd25519Verifier
+            )
         return cls(mesh_for_shards(shards), **kw)
+    if fused:
+        from consensus_tpu.models.fused import (
+            FusedEd25519BatchVerifier,
+            FusedEd25519RandomizedBatchVerifier,
+        )
+
+        cls = (
+            FusedEd25519RandomizedBatchVerifier
+            if randomized
+            else FusedEd25519BatchVerifier
+        )
+        return cls(**kw)
     cls = Ed25519RandomizedBatchVerifier if randomized else Ed25519BatchVerifier
     return cls(**kw)
 

@@ -142,13 +142,19 @@ def _cost_number(analysis, key: str) -> Optional[float]:
     return float(v) if v is not None else None
 
 
-def instrumented_jit(fn, name: str, *, registry: Optional[KernelRegistry] = None):
-    """``jax.jit(fn)`` plus accounting under ``name``.  Behaves exactly like
-    the jitted function; every failure inside the accounting is swallowed so
-    instrumentation can never break a verify path."""
+def instrumented_jit(
+    fn, name: str, *, registry: Optional[KernelRegistry] = None, **jit_kwargs
+):
+    """``jax.jit(fn, **jit_kwargs)`` plus accounting under ``name``.  Behaves
+    exactly like the jitted function; every failure inside the accounting is
+    swallowed so instrumentation can never break a verify path.  Extra
+    keyword arguments pass straight to ``jax.jit`` (the fused engines donate
+    their input buffers).  Wrappers may share a ``name`` — stats accumulate
+    into one bucket, which is how the shape-specialized fused aggregate
+    graphs report as a single kernel."""
     import jax
 
-    jitted = jax.jit(fn)
+    jitted = jax.jit(fn, **jit_kwargs)
     reg = registry if registry is not None else KERNELS
 
     def wrapper(*args, **kwargs):

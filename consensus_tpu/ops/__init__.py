@@ -1,5 +1,6 @@
-"""TPU numeric kernels: GF(2^255-19) limb arithmetic + edwards25519 group ops."""
+"""TPU numeric kernels: GF(2^255-19) limb arithmetic, edwards25519 group
+ops, and the fused front-end's hashing/scalar stages (SHA-512, mod-L)."""
 
-from consensus_tpu.ops import ed25519, field25519
+from consensus_tpu.ops import ed25519, field25519, scalar25519, sha512
 
-__all__ = ["field25519", "ed25519"]
+__all__ = ["field25519", "ed25519", "scalar25519", "sha512"]

@@ -347,10 +347,25 @@ def pow_2_252_m3(x: jnp.ndarray) -> jnp.ndarray:
     return mul(x, t0)         # 2^252 - 3
 
 
+#: p as little-endian bytes, for the on-device canonical-encoding check.
+P_BYTES_LE = np.frombuffer(P.to_bytes(32, "little"), dtype=np.uint8)
+
+
+def bytes_lt_p(y_bytes: jnp.ndarray) -> jnp.ndarray:
+    """On-device canonical-range check ``y < p`` over ``(32, batch)``
+    little-endian byte rows — the fused engine's twin of the host-side
+    lexicographic compare in ``models.ed25519._prep_compressed``."""
+    return limbs.lt_bytes(
+        y_bytes.astype(jnp.int32), jnp.asarray(P_BYTES_LE, dtype=jnp.int32)
+    )
+
+
 __all__ = [
     "LIMBS",
     "LIMB_BITS",
     "P",
+    "P_BYTES_LE",
+    "bytes_lt_p",
     "D",
     "D2",
     "SQRT_M1",

@@ -45,6 +45,29 @@ def carry_i32(x: jnp.ndarray, limb_bits: int = 8) -> tuple[jnp.ndarray, jnp.ndar
     return out, carry
 
 
+def lt_bytes(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Little-endian lexicographic ``a < b`` over byte rows.
+
+    ``a`` is ``(n_bytes, batch)``; ``b`` is a ``(n_bytes,)`` constant (a
+    modulus bound: ``S < L``, ``y < p``).  Branch-free: locate the most
+    significant differing byte with an argmax over the reversed
+    difference mask and read both operands there through a one-hot
+    contraction (same no-gather idiom as the point-table lookups).
+    Equal inputs compare False — the canonical-range checks all exclude
+    the bound itself.
+    """
+    n = a.shape[0]
+    b_col = b.astype(a.dtype)[:, None]
+    diff = a != b_col  # (n, batch)
+    first = jnp.argmax(diff[::-1], axis=0)  # offset of MS difference
+    one_hot = (
+        jnp.arange(n, dtype=jnp.int32)[:, None] == (n - 1 - first)[None]
+    ).astype(a.dtype)
+    a_at = (a * one_hot).sum(axis=0)
+    b_at = (b_col * one_hot).sum(axis=0)
+    return jnp.where(diff.any(axis=0), a_at < b_at, False)
+
+
 # --------------------------------------------------------------------------
 # Field-operation counting shim
 # --------------------------------------------------------------------------
@@ -99,6 +122,17 @@ def note_square(lanes: int = 1) -> None:
     """Record a field squaring over ``lanes`` independent elements."""
     if _COUNTERS:
         _note("squares", lanes)
+
+
+def note_byte_muls(byte_muls: int, lanes: int = 1) -> None:
+    """Record byte-level multiply work in field-mul equivalents.
+
+    The scalar stack (mod-L reduction, coefficient products) multiplies
+    byte limbs outside the 32x32 schoolbook shape; 1024 byte products is
+    one field mul's worth, rounded up so small stages stay visible in the
+    measured cost model."""
+    if _COUNTERS:
+        _note("muls", max(1, (byte_muls + 1023) // 1024) * lanes)
 
 
 @contextlib.contextmanager
@@ -158,7 +192,9 @@ __all__ = [
     "count_field_ops",
     "counted_scan",
     "counting",
+    "lt_bytes",
     "measure_field_ops",
+    "note_byte_muls",
     "note_mul",
     "note_square",
 ]

@@ -5,10 +5,14 @@ from consensus_tpu.parallel.sharding import (
     ShardedEcdsaP256Verifier,
     ShardedEd25519RandomizedVerifier,
     ShardedEd25519Verifier,
+    ShardedFusedEd25519RandomizedVerifier,
+    ShardedFusedEd25519Verifier,
     engine_padded_size,
     make_mesh,
     mesh_for_shards,
     sharded_batch_verify_fn,
+    sharded_fused_aggregate_fn,
+    sharded_fused_verify_fn,
     sharded_p256_verify_fn,
     sharded_verify_fn,
 )
@@ -21,7 +25,11 @@ __all__ = [
     "sharded_verify_fn",
     "sharded_batch_verify_fn",
     "sharded_p256_verify_fn",
+    "sharded_fused_verify_fn",
+    "sharded_fused_aggregate_fn",
     "ShardedEd25519Verifier",
     "ShardedEd25519RandomizedVerifier",
     "ShardedEcdsaP256Verifier",
+    "ShardedFusedEd25519Verifier",
+    "ShardedFusedEd25519RandomizedVerifier",
 ]

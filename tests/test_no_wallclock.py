@@ -75,6 +75,31 @@ def test_lint_honors_wallclock_ok_marker(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_lint_covers_fused_pipeline():
+    """The fused bytes-in → verdict-out pipeline derives Fiat–Shamir
+    transcripts ON DEVICE (ops/sha512.py hashing, ops/scalar25519.py mod-L
+    arithmetic, models/fused.py graph assembly); a wall-clock read in any
+    of these would break cross-replica coefficient determinism exactly
+    like one in models/aggregate.py.  Pin the lint's coverage of the fused
+    modules — presence first, then a walk rooted at each tree."""
+    ops_dir = os.path.join(_REPO, "consensus_tpu", "ops")
+    models_dir = os.path.join(_REPO, "consensus_tpu", "models")
+    assert {"sha512.py", "scalar25519.py"} <= {
+        f for f in os.listdir(ops_dir) if f.endswith(".py")
+    }
+    assert "fused.py" in set(os.listdir(models_dir))
+    for root in (ops_dir, models_dir):
+        proc = subprocess.run(
+            [sys.executable, _SCRIPT, root],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, (
+            f"fused pipeline tree {root} has wall-clock reads:\n"
+            + proc.stdout + proc.stderr
+        )
+
+
 def test_lint_covers_models_aggregate():
     """Half-aggregation (models/aggregate.py) derives its Fiat-Shamir
     coefficients from a deterministic transcript — a wall-clock read
