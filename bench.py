@@ -533,7 +533,14 @@ def _load_last_good(metric: str) -> dict:
         return {}
 
 
-def _save_last_good(metric: str, value: float, vs_baseline: float) -> None:
+def _save_last_good(
+    metric: str,
+    value: float,
+    vs_baseline: float,
+    *,
+    unit: str = "sigs/sec",
+    hardware: str = "v5e-1 via tunnel",
+) -> None:
     """Refresh the measurement trail after a successful live run."""
     try:
         with open(LAST_GOOD_PATH) as fh:
@@ -550,11 +557,11 @@ def _save_last_good(metric: str, value: float, vs_baseline: float) -> None:
         commit = "unknown"
     data[metric] = {
         "value": round(value, 1),
-        "unit": "sigs/sec",
+        "unit": unit,
         "vs_baseline": round(vs_baseline, 3),
         "commit": commit or "unknown",
         "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "hardware": "v5e-1 via tunnel",
+        "hardware": hardware,
     }
     tmp = LAST_GOOD_PATH + ".tmp"
     with open(tmp, "w") as fh:
@@ -563,11 +570,103 @@ def _save_last_good(metric: str, value: float, vs_baseline: float) -> None:
     os.replace(tmp, LAST_GOOD_PATH)
 
 
+#: Fixed trace seeds for the host-side ingress family — the measurement is
+#: a pure function of these, so run-to-run variance is wall-clock only.
+INGRESS_SEEDS = (0, 1)
+INGRESS_CLIENTS = 500
+INGRESS_DURATION = 10.0
+
+
+def bench_ingress() -> dict:
+    """``ingress`` family: host-side admission-plane throughput.
+
+    Replays fixed flood + duplicate-storm traces straight through an
+    :class:`~consensus_tpu.ingress.admission.AdmissionController` and times
+    the admit loop on the wall clock — no device, no sockets, so this
+    family always runs live.  Reports admitted requests per wall-second
+    (the rate one ingress process can make admission decisions at) and the
+    storm traces' dedup-hit ratio (trace-determined; a drift means the
+    dedup path changed, not the machine)."""
+    from consensus_tpu.ingress import (
+        AdmissionController,
+        duplicate_storm_spec,
+        flood_spec,
+        generate_trace,
+    )
+
+    offered = admitted = 0
+    storm_offered = storm_hits = 0
+    elapsed = 0.0
+    for seed in INGRESS_SEEDS:
+        for scenario, spec in (
+            ("flood", flood_spec(
+                clients=INGRESS_CLIENTS, duration=INGRESS_DURATION)),
+            ("storm", duplicate_storm_spec(
+                duration=INGRESS_DURATION, clients=INGRESS_CLIENTS)),
+        ):
+            trace = generate_trace(seed, spec)
+            ctrl = AdmissionController(
+                rate=spec.admission_rate, burst=spec.admission_burst
+            )
+            t0 = time.perf_counter()
+            for ev in trace:
+                ctrl.admit(ev.t, ev.info(), ev.size)
+            elapsed += time.perf_counter() - t0
+            offered += ctrl.offered
+            admitted += ctrl.admitted
+            if scenario == "storm":
+                storm_offered += ctrl.offered
+                storm_hits += ctrl.dedup_hits
+    rate = offered / elapsed if elapsed > 0 else 0.0
+    return {
+        "metric": "ingress_admission_throughput",
+        "value": round(rate, 1),
+        "unit": "reqs/sec",
+        "admitted_fraction": round(admitted / offered, 4),
+        "dedup_hit_ratio": round(storm_hits / storm_offered, 4),
+        "seeds": list(INGRESS_SEEDS),
+        "clients": INGRESS_CLIENTS,
+    }
+
+
+def bench_ingress_main() -> int:
+    """The ``ingress`` family entry point: live measurement with the same
+    structured-skip + last-good trail discipline as the device families (a
+    crash in the admission plane must not turn the bench lane red)."""
+    metric = "ingress_admission_throughput"
+    try:
+        record = bench_ingress()
+    except Exception as exc:  # noqa: BLE001 — any failure becomes a skip
+        last_good = _load_last_good(metric)
+        print(json.dumps({
+            "metric": metric,
+            "skipped": "ingress-bench-error",
+            "detail": repr(exc),
+            "last_good": dict(last_good, stale=True) if last_good else None,
+        }))
+        return 0
+    _save_last_good(
+        metric, record["value"], record["admitted_fraction"],
+        unit="reqs/sec", hardware="host",
+    )
+    print(json.dumps(record))
+    print(
+        f"# ingress admit-loop {record['value']:.0f} reqs/s "
+        f"(admitted {record['admitted_fraction']:.2%}, "
+        f"storm dedup-hit {record['dedup_hit_ratio']:.2%})",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main() -> None:
     from __graft_entry__ import _enable_compile_cache
 
     _enable_compile_cache()
     family = sys.argv[1] if len(sys.argv) > 1 else "ed25519"
+    if family == "ingress":
+        # Host-side family: no device probe, no JAX import.
+        sys.exit(bench_ingress_main())
     metric = {
         "p256": "ecdsa_p256_verify_throughput",
         "cert_verify": "cert_verify_throughput",

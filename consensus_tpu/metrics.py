@@ -49,6 +49,8 @@ OBS_ANOMALY_LEADER_FLAP_KEY = "obs_anomaly_leader_flap"
 OBS_ANOMALY_SYNC_LAG_KEY = "obs_anomaly_sync_lag"
 OBS_ANOMALY_VERIFY_COLLAPSE_KEY = "obs_anomaly_verify_collapse"
 OBS_ANOMALY_MEMBERSHIP_CHURN_KEY = "obs_anomaly_membership_churn"
+OBS_ANOMALY_ADMISSION_OVERLOAD_KEY = "obs_anomaly_admission_overload"
+OBS_ANOMALY_DEDUP_STORM_KEY = "obs_anomaly_dedup_storm"
 OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_COMMIT_STALL_KEY,
     OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
@@ -56,6 +58,8 @@ OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_SYNC_LAG_KEY,
     OBS_ANOMALY_VERIFY_COLLAPSE_KEY,
     OBS_ANOMALY_MEMBERSHIP_CHURN_KEY,
+    OBS_ANOMALY_ADMISSION_OVERLOAD_KEY,
+    OBS_ANOMALY_DEDUP_STORM_KEY,
 )
 
 #: Pinned instrument names for the membership-epoch subsystem
@@ -90,6 +94,30 @@ SIDECAR_KEYS = (
     SIDECAR_WAVE_LAUNCHES_KEY,
     SIDECAR_WAVE_SIGNATURES_KEY,
     SIDECAR_WAVE_TENANTS_KEY,
+)
+
+#: Pinned instrument names for the ingress plane (consensus_tpu/ingress/):
+#: the admission layer's offered/admitted/rate-limited/dedup accounting,
+#: the placement fleet's size and structured-reject reroutes, and the
+#: open-loop driver's commit latency.  Every admission decision is
+#: triple-booked: one of these counters, an ``ingress.<outcome>`` trace
+#: instant, and (through health snapshots) the ``admission_overload`` /
+#: ``dedup_storm`` obs detectors.
+INGRESS_OFFERED_KEY = "ingress_offered_total"
+INGRESS_ADMITTED_KEY = "ingress_admitted_total"
+INGRESS_RATE_LIMITED_KEY = "ingress_rate_limited_total"
+INGRESS_DEDUP_HITS_KEY = "ingress_dedup_hits_total"
+INGRESS_REROUTE_KEY = "ingress_reroute_total"
+INGRESS_FLEET_SIZE_KEY = "ingress_fleet_size"
+INGRESS_COMMIT_LATENCY_KEY = "ingress_commit_latency"
+INGRESS_KEYS = (
+    INGRESS_OFFERED_KEY,
+    INGRESS_ADMITTED_KEY,
+    INGRESS_RATE_LIMITED_KEY,
+    INGRESS_DEDUP_HITS_KEY,
+    INGRESS_REROUTE_KEY,
+    INGRESS_FLEET_SIZE_KEY,
+    INGRESS_COMMIT_LATENCY_KEY,
 )
 
 #: Pinned instrument names for half-aggregated quorum certs
@@ -141,6 +169,26 @@ PINNED_METRIC_KEYS: dict[str, str] = {
         "detector firings: ledger growth with zero verify launches",
     OBS_ANOMALY_MEMBERSHIP_CHURN_KEY:
         "detector firings: membership epoch churning within the churn window",
+    OBS_ANOMALY_ADMISSION_OVERLOAD_KEY:
+        "detector firings: admission rejecting a sustained fraction of "
+        "offered ingress load",
+    OBS_ANOMALY_DEDUP_STORM_KEY:
+        "detector firings: dedup cache absorbing a duplicate-retry storm",
+    INGRESS_OFFERED_KEY:
+        "client requests offered to the ingress admission layer",
+    INGRESS_ADMITTED_KEY:
+        "client requests admitted past rate limiting and dedup",
+    INGRESS_RATE_LIMITED_KEY:
+        "client requests rejected by the per-client token bucket",
+    INGRESS_DEDUP_HITS_KEY:
+        "duplicate client requests absorbed by the dedup cache",
+    INGRESS_REROUTE_KEY:
+        "admitted batches rerouted to the hash ring's next fleet candidate "
+        "after a structured admission reject",
+    INGRESS_FLEET_SIZE_KEY:
+        "verifier fleet servers currently in the placement ring (gauge)",
+    INGRESS_COMMIT_LATENCY_KEY:
+        "sim-seconds from open-loop arrival to fleet commit (histogram)",
     MEMBERSHIP_EPOCH_KEY:
         "membership epoch this replica is serving (gauge)",
     MEMBERSHIP_STALE_EPOCH_DROPPED_KEY:
@@ -623,6 +671,16 @@ class MetricsObs(_Bundle):
             "Membership-churn detector firings.",
             ln,
         )
+        self.count_anomaly_admission_overload = p.new_counter(
+            OBS_ANOMALY_ADMISSION_OVERLOAD_KEY,
+            "Ingress-admission-overload detector firings.",
+            ln,
+        )
+        self.count_anomaly_dedup_storm = p.new_counter(
+            OBS_ANOMALY_DEDUP_STORM_KEY,
+            "Ingress duplicate-retry-storm detector firings.",
+            ln,
+        )
 
     def anomaly_counter(self, kind: str) -> Counter:
         """The pinned counter for detector ``kind`` (its short name, e.g.
@@ -705,6 +763,55 @@ class MetricsSidecar(_Bundle):
         )
 
 
+class MetricsIngress(_Bundle):
+    """Ingress-plane instruments — consensus_tpu addition, fed by the
+    admission layer (ingress/admission.py), the placement fleet
+    (ingress/placement.py), and the open-loop trace driver
+    (ingress/driver.py).  ``offered = admitted + rate_limited + dedup_hits``
+    holds by construction; the reroute counter tracks structured
+    ``TenantAdmissionReject`` retries hopping to the hash ring's next
+    candidate."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_offered = p.new_counter(
+            INGRESS_OFFERED_KEY,
+            "Client requests offered to the ingress admission layer.",
+            ln,
+        )
+        self.count_admitted = p.new_counter(
+            INGRESS_ADMITTED_KEY,
+            "Client requests admitted past rate limiting and dedup.",
+            ln,
+        )
+        self.count_rate_limited = p.new_counter(
+            INGRESS_RATE_LIMITED_KEY,
+            "Client requests rejected by the per-client token bucket.",
+            ln,
+        )
+        self.count_dedup_hits = p.new_counter(
+            INGRESS_DEDUP_HITS_KEY,
+            "Duplicate client requests absorbed by the dedup cache.",
+            ln,
+        )
+        self.count_reroutes = p.new_counter(
+            INGRESS_REROUTE_KEY,
+            "Admitted batches rerouted to the next fleet candidate after a "
+            "structured admission reject.",
+            ln,
+        )
+        self.fleet_size = p.new_gauge(
+            INGRESS_FLEET_SIZE_KEY,
+            "Verifier fleet servers currently in the placement ring.",
+            ln,
+        )
+        self.commit_latency = p.new_histogram(
+            INGRESS_COMMIT_LATENCY_KEY,
+            "Sim-seconds from open-loop arrival to fleet commit.",
+            ln,
+        )
+
+
 class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
@@ -744,6 +851,7 @@ class Metrics:
         self.obs = MetricsObs(provider, label_names)
         self.membership = MetricsMembership(provider, label_names)
         self.sidecar = MetricsSidecar(provider, label_names)
+        self.ingress = MetricsIngress(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
         """Bind embedder label values on every bundle (e.g. the channel id).
@@ -778,6 +886,7 @@ __all__ = [
     "MetricsObs",
     "MetricsMembership",
     "MetricsSidecar",
+    "MetricsIngress",
     "extend_label_names",
     "VERIFY_LAUNCH_BATCH_KEY",
     "WAL_RECORDS_PER_FSYNC_KEY",
@@ -793,7 +902,17 @@ __all__ = [
     "OBS_ANOMALY_SYNC_LAG_KEY",
     "OBS_ANOMALY_VERIFY_COLLAPSE_KEY",
     "OBS_ANOMALY_MEMBERSHIP_CHURN_KEY",
+    "OBS_ANOMALY_ADMISSION_OVERLOAD_KEY",
+    "OBS_ANOMALY_DEDUP_STORM_KEY",
     "OBS_ANOMALY_KEYS",
+    "INGRESS_OFFERED_KEY",
+    "INGRESS_ADMITTED_KEY",
+    "INGRESS_RATE_LIMITED_KEY",
+    "INGRESS_DEDUP_HITS_KEY",
+    "INGRESS_REROUTE_KEY",
+    "INGRESS_FLEET_SIZE_KEY",
+    "INGRESS_COMMIT_LATENCY_KEY",
+    "INGRESS_KEYS",
     "MEMBERSHIP_EPOCH_KEY",
     "MEMBERSHIP_STALE_EPOCH_DROPPED_KEY",
     "MEMBERSHIP_JOIN_ATTEMPTS_KEY",

@@ -595,6 +595,14 @@ class SidecarVerifierClient:
     ``auth_secret`` then holds the PER-TENANT secret and the handshake
     binds the tenant id into every derivation.  Leave None for the legacy
     single-tenant handshake.
+
+    ``fleet`` / ``fleet_id``: placement-aware retry.  ``fleet`` is a
+    :class:`~consensus_tpu.ingress.placement.SidecarFleet` and ``fleet_id``
+    this client's own server id on its ring.  A structured
+    :class:`TenantAdmissionReject` then means THIS server's tenant queue is
+    full, not that the fleet is — the batch is handed to the ring's next
+    candidate for the tenant (pinned ``ingress_reroute_total`` counts the
+    handoffs) before any local fallback.
     """
 
     def __init__(
@@ -610,6 +618,8 @@ class SidecarVerifierClient:
         tenant: Optional[str] = None,
         fault_plan=None,
         tracer=None,
+        fleet=None,
+        fleet_id: Optional[str] = None,
     ) -> None:
         #: Optional testing FaultPlan (consensus_tpu/testing/faults.py):
         #: arms the sidecar.send.io_error / sidecar.recv.short_read seams.
@@ -627,6 +637,10 @@ class SidecarVerifierClient:
         self._tenant = tenant
         if tenant is not None and auth_secret is None:
             raise ValueError("tenant mode requires auth_secret (the tenant secret)")
+        self._fleet = fleet
+        self._fleet_id = fleet_id
+        if fleet is not None and fleet_id is None:
+            raise ValueError("fleet mode requires fleet_id (this server's ring id)")
         self._mac_key: Optional[bytes] = None  # per-connection session key
         self._lock = threading.Lock()  # guards socket create + pending map
         self._sock: Optional[socket.socket] = None
@@ -667,6 +681,25 @@ class SidecarVerifierClient:
             )
         try:
             result = self._roundtrip(messages, signatures, public_keys)
+        except TenantAdmissionReject as reject:
+            rerouted = self._fleet_reroute(
+                messages, signatures, public_keys, reject
+            )
+            if rerouted is not None:
+                return rerouted
+            if self._local is None:
+                raise
+            logger.error(
+                "sidecar admission reject (%r) with no accepting fleet peer "
+                "— falling back to LOCAL host verification for %d signatures",
+                reject,
+                n,
+            )
+            if tracer is not None and tracer.enabled:
+                tracer.instant("net", "sidecar.fallback", n=n)
+            return np.asarray(
+                self._local.verify_host(messages, signatures, public_keys)
+            )
         except Exception as exc:
             if self._local is None:
                 raise
@@ -686,6 +719,37 @@ class SidecarVerifierClient:
                 self._local.verify_host(messages, signatures, public_keys)
             )
         return result
+
+    def _fleet_reroute(self, messages, signatures, keys, reject):
+        """Placement-aware retry: walk the hash ring's remaining candidates
+        for our tenant and hand the batch to the first peer that accepts
+        it.  Per-tenant admission pressure is a PER-SERVER property, so the
+        rendezvous order gives every tenant the same deterministic failover
+        chain.  Returns None when no fleet is configured or every peer
+        refuses (the caller then falls back locally / re-raises)."""
+        fleet = self._fleet
+        if fleet is None:
+            return None
+        tenant = self._tenant or ""
+        for server_id in fleet.candidates(tenant):
+            if server_id == self._fleet_id:
+                continue
+            peer = fleet.client_for(server_id)
+            if peer is self:
+                continue
+            try:
+                result = peer.verify_batch(messages, signatures, keys)
+            except Exception:
+                continue  # rejected or unreachable peer: try the next
+            fleet.on_reroute(tenant, self._fleet_id, server_id)
+            logger.warning(
+                "tenant %r admission-rejected by %r (depth %d/%d) — "
+                "rerouted batch to fleet peer %r",
+                tenant, self._fleet_id, reject.queue_depth, reject.limit,
+                server_id,
+            )
+            return result
+        return None
 
     def _mark_suspect(self) -> None:
         """A timed-out request means the sidecar is wedged (its device call

@@ -6,7 +6,7 @@ fixed-seed run is byte-identical across replays.  Detectors are
 EDGE-TRIGGERED: a condition fires once at onset and re-arms only after the
 condition clears, so a 300-second stall is one anomaly, not 300.
 
-The six kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
+The eight kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
 
 ``commit_stall``        a running node has pending pool work but its ledger
                         has not grown for ``stall_window`` sim-seconds
@@ -27,6 +27,19 @@ The six kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
                         administrative cadence (an elastic-membership run
                         gone thrashy, or an adversary replaying admin
                         traffic)
+``admission_overload`` between two samples, the ingress admission layer
+                        rate-limited ``overload_reject_fraction``+ of at
+                        least ``overload_min_offered`` offered requests —
+                        sustained demand past the per-client budgets
+``dedup_storm``         between two samples, ``dedup_hit_fraction``+ of at
+                        least ``dedup_min_offered`` offered requests were
+                        duplicates — a retry storm landing on the dedup
+                        cache
+
+The two ingress detectors read OPTIONAL health fields
+(``ingress_offered`` / ``ingress_rate_limited`` / ``ingress_dedup_hits``,
+fed by ingress/driver.py); cluster samples never carry them, so existing
+fixed-seed anomaly streams are untouched.
 """
 
 from __future__ import annotations
@@ -42,6 +55,8 @@ ANOMALY_KINDS = (
     "sync_lag",
     "verify_collapse",
     "membership_churn",
+    "admission_overload",
+    "dedup_storm",
 )
 
 
@@ -58,6 +73,10 @@ class DetectorThresholds:
     collapse_decisions: int = 3
     churn_epochs: int = 2
     churn_window: float = 120.0
+    overload_min_offered: int = 20
+    overload_reject_fraction: float = 0.5
+    dedup_min_offered: int = 20
+    dedup_hit_fraction: float = 0.5
 
     def validate(self) -> None:
         if self.stall_window <= 0 or self.storm_window <= 0 or self.flap_window <= 0:
@@ -68,6 +87,11 @@ class DetectorThresholds:
                self.lag_decisions, self.collapse_decisions,
                self.churn_epochs) < 1:
             raise ValueError("detector counts must be >= 1")
+        if min(self.overload_min_offered, self.dedup_min_offered) < 1:
+            raise ValueError("detector counts must be >= 1")
+        if not (0.0 < self.overload_reject_fraction <= 1.0
+                and 0.0 < self.dedup_hit_fraction <= 1.0):
+            raise ValueError("detector fractions must be in (0, 1]")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,7 +118,7 @@ class _NodeState:
     __slots__ = (
         "stall_since", "last_ledger", "view_changes", "leader_changes",
         "last_view", "last_leader", "collapse_base",
-        "epoch_changes", "last_epoch",
+        "epoch_changes", "last_epoch", "ingress_base",
     )
 
     def __init__(self) -> None:
@@ -107,6 +131,9 @@ class _NodeState:
         self.collapse_base: Optional[tuple[int, float]] = None  # (ledger, launches)
         self.epoch_changes: deque = deque()    # (t, epoch)
         self.last_epoch: Optional[int] = None
+        #: Previous sample's cumulative (offered, rate_limited, dedup_hits)
+        #: — the ingress detectors fire on PER-SAMPLE deltas.
+        self.ingress_base: Optional[tuple[int, int, int]] = None
 
 
 class DetectorBank:
@@ -222,6 +249,42 @@ class DetectorBank:
                 f"{len(st.epoch_changes)} membership epoch changes within "
                 f"{th.churn_window:g}s (now serving epoch {epoch})",
             )
+
+            # --- ingress: admission overload / dedup storm -------------
+            offered = h.get("ingress_offered")
+            if offered is None:
+                # Not an ingress-plane sample: clear state + latches so
+                # cluster health dicts keep their pre-ingress streams.
+                st.ingress_base = None
+                self._active.discard(("admission_overload", nid))
+                self._active.discard(("dedup_storm", nid))
+            else:
+                limited = h.get("ingress_rate_limited", 0)
+                dedup = h.get("ingress_dedup_hits", 0)
+                if st.ingress_base is None:
+                    st.ingress_base = (0, 0, 0)
+                d_off = offered - st.ingress_base[0]
+                d_lim = limited - st.ingress_base[1]
+                d_dup = dedup - st.ingress_base[2]
+                st.ingress_base = (offered, limited, dedup)
+                overloaded = (
+                    d_off >= th.overload_min_offered
+                    and d_lim >= th.overload_reject_fraction * d_off
+                )
+                self._edge(
+                    fired, "admission_overload", nid, t, overloaded,
+                    f"rate-limited {d_lim}/{d_off} offered requests since "
+                    "the last sample",
+                )
+                storming = (
+                    d_off >= th.dedup_min_offered
+                    and d_dup >= th.dedup_hit_fraction * d_off
+                )
+                self._edge(
+                    fired, "dedup_storm", nid, t, storming,
+                    f"dedup absorbed {d_dup}/{d_off} offered requests since "
+                    "the last sample",
+                )
 
             # --- verify-launch-rate collapse ---------------------------
             nl = (launches or {}).get(nid)

@@ -44,6 +44,24 @@ Adversary vocabulary (``ChaosAction.kind``):
                                 ``epoch_tagging``; ``generate(churn=False)``
                                 draws a byte-identical schedule to before
                                 the vocabulary existed.
+``region_partition`` / ``leader_shift``
+                                WAN vocabulary (``generate(wan=<profile>)``
+                                only).  A WAN schedule pins every node to a
+                                region of the named :data:`WAN_PROFILES`
+                                entry (round-robin over sorted ids) and the
+                                engine arms per-link latency distributions
+                                (``set_jitter``: intra-region base+spread vs
+                                the profile's inter-region matrix), re-armed
+                                after every heal since ``heal()`` clears all
+                                knobs.  ``region_partition`` cuts one whole
+                                region off; ``leader_shift`` multiplies the
+                                base latency of every link INTO one region —
+                                the leader-placement sensitivity probe (a
+                                leader in the slowed region must hand over
+                                or drag commit latency, never violate
+                                safety).  ``generate(wan=None)`` draws a
+                                byte-identical schedule to before the
+                                vocabulary existed.
 
 Everything runs on the SimScheduler's virtual clock — no wall-clock reads
 anywhere (scripts/check_no_wallclock.py lints this module too).
@@ -69,6 +87,81 @@ from consensus_tpu.wire import EpochTagged
 #: The churn vocabulary: actions that change the member set through an
 #: ordered reconfiguration (not a topology knob).
 CHURN_KINDS = ("add_node", "remove_node")
+
+#: The WAN vocabulary: region-shaped topology actions, only drawn when a
+#: schedule names a geography profile.
+WAN_KINDS = ("region_partition", "leader_shift")
+
+#: Geography bank: per-profile region names, intra-region link latency
+#: ``(base, jitter)`` in sim-seconds, and the inter-region latency matrix
+#: keyed on the SORTED region pair.  Values are loosely shaped on public
+#: cloud RTT tables — what matters for the harness is the ORDER between
+#: them (intra << transatlantic << transpacific), not the digits.
+WAN_PROFILES = {
+    "3region": {
+        "regions": ("us-east", "eu-west", "ap-south"),
+        "intra": (0.002, 0.001),
+        "inter": {
+            ("ap-south", "eu-west"): (0.075, 0.020),
+            ("ap-south", "us-east"): (0.110, 0.025),
+            ("eu-west", "us-east"): (0.040, 0.010),
+        },
+    },
+    "2region-lopsided": {
+        "regions": ("us-east", "ap-south"),
+        "intra": (0.002, 0.001),
+        "inter": {
+            ("ap-south", "us-east"): (0.140, 0.040),
+        },
+    },
+    "global5": {
+        "regions": ("us-east", "us-west", "eu-west", "ap-south", "sa-east"),
+        "intra": (0.002, 0.001),
+        "inter": {
+            ("ap-south", "eu-west"): (0.075, 0.020),
+            ("ap-south", "sa-east"): (0.160, 0.040),
+            ("ap-south", "us-east"): (0.110, 0.025),
+            ("ap-south", "us-west"): (0.090, 0.020),
+            ("eu-west", "sa-east"): (0.095, 0.025),
+            ("eu-west", "us-east"): (0.040, 0.010),
+            ("eu-west", "us-west"): (0.065, 0.015),
+            ("sa-east", "us-east"): (0.060, 0.015),
+            ("sa-east", "us-west"): (0.085, 0.020),
+            ("us-east", "us-west"): (0.030, 0.008),
+        },
+    },
+}
+
+
+def region_map(profile: str, ids) -> dict:
+    """node id -> region name: round-robin over SORTED ids, so placement is
+    a pure function of (profile, member set) and survives churn."""
+    regions = WAN_PROFILES[profile]["regions"]
+    return {
+        nid: regions[i % len(regions)]
+        for i, nid in enumerate(sorted(ids))
+    }
+
+
+def wan_links(profile: str, ids) -> tuple:
+    """Every ordered link ``(a, b, base, jitter)`` for the member set under
+    ``profile`` — the engine feeds these straight into ``set_jitter``."""
+    prof = WAN_PROFILES[profile]
+    rmap = region_map(profile, ids)
+    intra_base, intra_jitter = prof["intra"]
+    links = []
+    ordered = sorted(ids)
+    for a in ordered:
+        for b in ordered:
+            if a == b:
+                continue
+            ra, rb = rmap[a], rmap[b]
+            if ra == rb:
+                base, jitter = intra_base, intra_jitter
+            else:
+                base, jitter = prof["inter"][tuple(sorted((ra, rb)))]
+            links.append((a, b, base, jitter))
+    return tuple(links)
 
 #: The soak suite's fast-timeout profile; chaos runs use the same one so a
 #: 25-action schedule finishes in well under a sim-hour.
@@ -114,6 +207,9 @@ class ChaosSchedule:
     n: int = 4
     durability_window: float = 0.0
     actions: tuple = ()
+    #: WAN geography profile name (a :data:`WAN_PROFILES` key) or None.
+    #: Carried on the schedule so shrunk subsets keep their geography.
+    wan: Optional[str] = None
 
     @classmethod
     def generate(
@@ -125,6 +221,7 @@ class ChaosSchedule:
         durability_window: float = 0.0,
         start: float = 30.0,
         churn: bool = False,
+        wan: Optional[str] = None,
     ) -> "ChaosSchedule":
         """Derive a feasible schedule from ``seed``: action times are
         cumulative uniform(5, 40) gaps from ``start``, kinds are weighted
@@ -135,7 +232,17 @@ class ChaosSchedule:
         vocabulary (bounded: member set never below 4 or more than two
         above ``n``, removes only target live non-byzantine members);
         ``churn=False`` leaves every RNG draw byte-identical to the
-        pre-churn generator, so pinned schedules replay unchanged."""
+        pre-churn generator, so pinned schedules replay unchanged.
+
+        ``wan=<profile>`` (a :data:`WAN_PROFILES` key) pins the geography
+        and adds ``region_partition`` / ``leader_shift`` to the vocabulary;
+        ``wan=None`` consumes no extra RNG, so pre-WAN schedules replay
+        byte-identically."""
+        if wan is not None and wan not in WAN_PROFILES:
+            raise ValueError(
+                f"unknown WAN profile {wan!r}; "
+                f"choose from {sorted(WAN_PROFILES)}"
+            )
         rng = random.Random(seed)
         ids = list(range(1, n + 1))
         _, f = compute_quorum(n)
@@ -146,6 +253,9 @@ class ChaosSchedule:
         if churn:
             kinds += list(CHURN_KINDS)
             weights += [1.2, 1.2]
+        if wan is not None:
+            kinds += list(WAN_KINDS)
+            weights += [1.5, 1.0]
         members = set(ids)
         next_id = n + 1
         t = start
@@ -228,6 +338,24 @@ class ChaosSchedule:
                 down.discard(node)
                 actions.append(ChaosAction(at=t, kind="remove_node",
                                            args={"node": node}))
+            elif kind == "region_partition":
+                # The concrete group is baked in at generate time so the
+                # action repros stand alone (no geography lookup needed).
+                rmap = region_map(wan, ids)
+                region = rng.choice(sorted(set(rmap.values())))
+                group = tuple(sorted(i for i in ids if rmap[i] == region))
+                actions.append(ChaosAction(
+                    at=t, kind="region_partition",
+                    args={"region": region, "group": group},
+                ))
+            elif kind == "leader_shift":
+                rmap = region_map(wan, ids)
+                region = rng.choice(sorted(set(rmap.values())))
+                actions.append(ChaosAction(
+                    at=t, kind="leader_shift",
+                    args={"region": region,
+                          "factor": rng.choice([2.0, 4.0])},
+                ))
             else:  # arm_fault: the armed replica dies at the seam firing
                 node = rng.choice([i for i in ids if i not in down])
                 down.add(node)
@@ -238,7 +366,7 @@ class ChaosSchedule:
                           "hit": rng.randrange(1, 4)},
                 ))
         return cls(seed=seed, n=n, durability_window=durability_window,
-                   actions=tuple(actions))
+                   actions=tuple(actions), wan=wan)
 
 
 @dataclasses.dataclass
@@ -353,6 +481,9 @@ class ChaosEngine:
         #: consulted without ``crypto``, so existing pinned schedules keep
         #: their exact mutation sequence.
         self._sig_rng = random.Random(schedule.seed ^ 0x516)
+        #: Active leader_shift ``(region, factor)`` or None — heal clears
+        #: it along with every other topology knob.
+        self._wan_shift: Optional[tuple] = None
 
     # --- bookkeeping --------------------------------------------------------
 
@@ -370,6 +501,22 @@ class ChaosEngine:
 
     def _fmt_args(self, action: ChaosAction) -> str:
         return " ".join(f"{k}={v!r}" for k, v in sorted(action.args.items()))
+
+    def _apply_wan_links(self) -> None:
+        """(Re-)arm the geography: one ``set_jitter`` per ordered member
+        link, with an active leader_shift multiplying the base of every
+        link INTO the shifted region.  Idempotent; called at start and
+        after every heal, since ``heal()`` clears all jitter knobs."""
+        if self.schedule.wan is None:
+            return
+        net = self.cluster.network
+        ids = sorted(net.node_ids())
+        rmap = region_map(self.schedule.wan, ids)
+        shift = self._wan_shift
+        for a, b, base, jitter in wan_links(self.schedule.wan, ids):
+            if shift is not None and rmap[b] == shift[0]:
+                base *= shift[1]
+            net.set_jitter(a, b, base, jitter)
 
     # --- the adversary actions ---------------------------------------------
 
@@ -407,6 +554,19 @@ class ChaosEngine:
             return True
         if kind == "heal":
             net.heal()
+            self._wan_shift = None
+            self._apply_wan_links()
+            return True
+        if kind == "region_partition":
+            if self.schedule.wan is None:
+                return False
+            net.partition(list(args["group"]))
+            return True
+        if kind == "leader_shift":
+            if self.schedule.wan is None:
+                return False
+            self._wan_shift = (args["region"], args["factor"])
+            self._apply_wan_links()
             return True
         if kind == "loss":
             net.set_loss(args["a"], args["b"], args["p"])
@@ -442,6 +602,7 @@ class ChaosEngine:
             if not self._order_reconfig(sorted(members | {node_id})):
                 return False
             self.cluster.add_node(node_id)
+            self._apply_wan_links()  # geography follows the member set
             return True
         if kind == "remove_node":
             node_id = args["node"]
@@ -461,6 +622,7 @@ class ChaosEngine:
             if node.consensus is not None and node.consensus._running:
                 return False  # stranded (e.g. partitioned evictee): leave it
             self.cluster.remove_node(node_id)
+            self._apply_wan_links()  # geography follows the member set
             return True
         if kind == "arm_fault":
             node = nodes.get(args["node"])
@@ -677,8 +839,10 @@ class ChaosEngine:
             self.recorder.attach_scheduler(self.cluster.scheduler)
             self.recorder.attach_monitor(self.monitor)
         self.cluster.start()
+        self._apply_wan_links()
         self._emit(f"{self._now():10.4f} start n={sched.n} seed={sched.seed} "
-                   f"window={sched.durability_window!r}")
+                   f"window={sched.durability_window!r}"
+                   + (f" wan={sched.wan}" if sched.wan else ""))
 
         # Warm up: the cluster must order a block before the adversary acts.
         self._submit(self.WARMUP_REQUESTS)
@@ -712,6 +876,8 @@ class ChaosEngine:
             # (m follows the final member set under churn; a retired
             # evictee is neither restarted nor counted).
             self.cluster.network.heal()
+            self._wan_shift = None
+            self._apply_wan_links()
             self.cluster.network.mutate_send = None
             self._byz_rules.clear()
             self._disarm_faults()
@@ -867,6 +1033,7 @@ def format_repro(result: ChaosResult) -> str:
         f"    seed={s.seed!r},",
         f"    n={s.n!r},",
         f"    durability_window={s.durability_window!r},",
+        f"    wan={s.wan!r},",
         "    actions=(",
     ]
     for a in s.actions:
@@ -888,6 +1055,10 @@ __all__ = [
     "ChaosResult",
     "ChaosSchedule",
     "DEFAULT_TWEAKS",
+    "WAN_KINDS",
+    "WAN_PROFILES",
     "format_repro",
+    "region_map",
     "shrink",
+    "wan_links",
 ]

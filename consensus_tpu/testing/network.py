@@ -77,6 +77,11 @@ class SimNetwork:
         self._cut_links: set[tuple[int, int]] = set()
         self._loss: dict[tuple[int, int], float] = {}
         self._delay: dict[tuple[int, int], float] = {}
+        #: (a, b) -> (base, spread): per-link latency DISTRIBUTION — each
+        #: send draws uniform(base, base + spread) from the seeded RNG.
+        #: The WAN scenario bank's geography knob; unarmed links consume
+        #: no RNG, so non-WAN schedules replay byte-identically.
+        self._jitter: dict[tuple[int, int], tuple[float, float]] = {}
         self._duplicate: dict[tuple[int, int], float] = {}
         self._reorder: dict[tuple[int, int], float] = {}
         self._replay: dict[tuple[int, int], float] = {}
@@ -181,6 +186,7 @@ class SimNetwork:
         self._disconnected.clear()
         self._loss.clear()
         self._delay.clear()
+        self._jitter.clear()
         self._duplicate.clear()
         self._reorder.clear()
         self._replay.clear()
@@ -223,6 +229,20 @@ class SimNetwork:
     def set_delay(self, a: int, b: int, delay: float) -> None:
         self._delay[(a, b)] = delay
 
+    def set_jitter(
+        self, a: int, b: int, base: float, spread: float = 0.0
+    ) -> None:
+        """Give the directed link a->b a latency DISTRIBUTION: each send is
+        delayed uniform(base, base + spread), drawn from the network's
+        seeded RNG.  This is the WAN geography primitive (chaos WAN
+        profiles arm it per region pair); it composes with ``set_delay`` by
+        taking whichever is larger, so a chaos ``delay`` degradation still
+        bites on a WAN link.  Cleared by :meth:`heal` like every knob — the
+        chaos engine re-arms geography after heals."""
+        if base < 0 or spread < 0:
+            raise ValueError("jitter base and spread must be >= 0")
+        self._jitter[(a, b)] = (base, spread)
+
     # --- transport ---------------------------------------------------------
 
     def _record_injected(self, kind: str, sender: int, target: int) -> None:
@@ -251,9 +271,16 @@ class SimNetwork:
             if payload is None:
                 self._record_injected("dropped", sender, target)
                 return
-        delay = self._delay.get((sender, target), self.default_delay)
-
         link = (sender, target)
+        jitter = self._jitter.get(link)
+        if jitter is not None:
+            base, spread = jitter
+            drawn = base + (self.rng.random() * spread if spread else 0.0)
+            override = self._delay.get(link)
+            delay = drawn if override is None else max(drawn, override)
+        else:
+            delay = self._delay.get(link, self.default_delay)
+
         replay_p = self._replay.get(link, 0.0)
         if replay_p:
             buf = self._replay_buffers[link]

@@ -22,6 +22,14 @@ Examples:
 without it, schedules are byte-identical to pre-churn sweeps of the same
 seeds.
 
+``--wan <profile>`` pins a WAN geography from the scenario bank
+(testing/chaos.py WAN_PROFILES): every link gets a per-region latency
+distribution, and region_partition / leader_shift join the adversary
+vocabulary — region-shaped cuts and leader-placement sensitivity probes.
+Without it, schedules are byte-identical to pre-WAN sweeps.
+
+    python scripts/chaos_sweep.py --start 0 --count 50 --wan 3region
+
 Every seed runs with the observability plane sampling (read-only: ledgers
 and verdicts are identical to an unsampled run) and emits one per-seed JSON
 line with its anomaly-detector counts and the final health snapshot of
@@ -48,6 +56,7 @@ sys.path.insert(0, ".")  # runnable from the repo root without installing
 
 from consensus_tpu.config import ObsConfig  # noqa: E402
 from consensus_tpu.testing.chaos import (  # noqa: E402
+    WAN_PROFILES,
     ChaosEngine,
     ChaosSchedule,
     format_repro,
@@ -63,6 +72,7 @@ def run_sweep(args) -> int:
         schedule = ChaosSchedule.generate(
             seed, n=args.nodes, steps=args.steps,
             durability_window=args.window, churn=args.churn,
+            wan=args.wan,
         )
         # cert_mode="half-agg" needs an aggregation-capable verifier, so it
         # implies the real-crypto harness; "full" keeps the seed-identical
@@ -115,6 +125,7 @@ def run_sweep(args) -> int:
             "steps": args.steps,
             "window": args.window,
             "churn": args.churn,
+            "wan": args.wan,
             "cert_mode": args.cert_mode,
         },
     }
@@ -140,6 +151,10 @@ def main() -> int:
     ap.add_argument("--churn", action="store_true",
                     help="add elastic-membership actions (add_node / "
                          "remove_node) to each schedule's vocabulary")
+    ap.add_argument("--wan", choices=sorted(WAN_PROFILES), default=None,
+                    help="pin a WAN geography profile: per-link latency "
+                         "distributions plus region_partition / "
+                         "leader_shift in the vocabulary")
     ap.add_argument("--cert-mode", choices=("full", "half-agg"),
                     default="full",
                     help='quorum-cert format: "half-agg" runs every seed '

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Ingress seed sweep: replay generated client traces open-loop against a
+hashed sidecar fleet and report per-seed verdicts plus one machine-readable
+JSON summary line.
+
+The pytest-gated smoke set (tests/test_ingress.py) keeps tier-1 fast; THIS
+is the wide-net tool — point it at thousands of trace seeds and scenarios
+overnight.  The verdict per seed is the ingress plane's core promise:
+honest (in-rate-limit) clients are NEVER starved — every honest offered
+request is admitted, no matter how hard the flood or duplicate-retry storm
+leans on the admission layer.  Clean soaks additionally require total
+detector silence.
+
+Scenarios (consensus_tpu/ingress/workload.py):
+
+    clean   all-honest soak: no rate limiting, no dedup, no anomalies
+    flood   a flood cohort at 10x the admission budget (bursty, hot-tenant
+            skewed): admission_overload must fire, honest stay whole
+    storm   duplicate-retry storms across the middle of the run:
+            dedup_storm must fire, honest stay whole
+
+Every seed emits one JSON line:
+
+    {"seed": S, "ok": true, "scenario": "flood", "offered": ...,
+     "admitted": ..., "rate_limited": ..., "dedup_hits": ...,
+     "committed": ..., "latency_p99": ..., "anomalies": {...}}
+
+The final stdout line is always a single JSON object:
+
+    {"swept": N, "failed": K, "seeds_failed": [...], "anomalies": {...},
+     "params": {...}}
+
+Exit status: 0 when every seed passes, 1 otherwise.
+
+Examples:
+
+    python scripts/ingress_sweep.py --start 0 --count 20
+    python scripts/ingress_sweep.py --count 5 --scenario storm --clients 2000
+    python scripts/ingress_sweep.py --count 100 --scenario clean \\
+        --json-out /tmp/ingress.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # runnable from the repo root without installing
+
+from consensus_tpu.ingress import (  # noqa: E402
+    IngressDriver,
+    clean_spec,
+    duplicate_storm_spec,
+    flood_spec,
+    generate_trace,
+)
+
+SCENARIOS = ("clean", "flood", "storm")
+
+
+def _make_spec(scenario: str, clients: int, duration: float):
+    if scenario == "clean":
+        return clean_spec(clients=clients, duration=duration)
+    if scenario == "flood":
+        return flood_spec(clients=clients, duration=duration)
+    if scenario == "storm":
+        return duplicate_storm_spec(duration=duration, clients=clients)
+    raise ValueError(f"unknown scenario {scenario!r}")
+
+
+def run_sweep(args) -> int:
+    failed: list[int] = []
+    anomaly_totals: dict[str, int] = {}
+    spec = _make_spec(args.scenario, args.clients, args.duration)
+    for seed in range(args.start, args.start + args.count):
+        trace = generate_trace(seed, spec)
+        driver = IngressDriver(
+            trace, spec, seed=seed, servers=args.servers,
+            queue_limit=args.queue_limit,
+        )
+        summary = driver.run()
+        for kind, k in summary["anomalies"].items():
+            anomaly_totals[kind] = anomaly_totals.get(kind, 0) + k
+        # The non-starvation verdict: every honest offered request admitted.
+        ok = summary["admitted_honest"] == summary["offered_honest"]
+        if args.scenario == "clean":
+            # Clean soaks must also keep every detector silent.
+            ok = ok and not summary["anomalies"]
+        line = {"seed": seed, "ok": ok, "scenario": args.scenario}
+        line.update(summary)
+        print(json.dumps(line, sort_keys=True))
+        if ok:
+            if args.verbose:
+                print(f"seed {seed}: ok ({summary['offered']} offered, "
+                      f"{summary['committed']} committed)")
+            continue
+        failed.append(seed)
+        print(f"seed {seed}: FAIL honest admitted "
+              f"{summary['admitted_honest']}/{summary['offered_honest']}"
+              + (", anomalies on a clean soak: "
+                 f"{summary['anomalies']}" if args.scenario == "clean"
+                 and summary["anomalies"] else ""))
+
+    summary_line = {
+        "swept": args.count,
+        "failed": len(failed),
+        "seeds_failed": failed,
+        "anomalies": dict(sorted(anomaly_totals.items())),
+        "params": {
+            "start": args.start,
+            "scenario": args.scenario,
+            "clients": args.clients,
+            "duration": args.duration,
+            "servers": args.servers,
+            "queue_limit": args.queue_limit,
+        },
+    }
+    line = json.dumps(summary_line, sort_keys=True)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if failed else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("--start", type=int, default=0, help="first trace seed")
+    ap.add_argument("--count", type=int, default=20, help="number of seeds")
+    ap.add_argument("--scenario", choices=SCENARIOS, default="flood",
+                    help="trace shape per seed (default: flood)")
+    ap.add_argument("--clients", type=int, default=1000,
+                    help="simulated client population per trace")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="trace duration (sim seconds)")
+    ap.add_argument("--servers", type=int, default=4,
+                    help="simulated sidecar fleet size")
+    ap.add_argument("--queue-limit", type=int, default=512,
+                    help="per-server backlog bound (structured reject past it)")
+    ap.add_argument("--json-out", help="also write the summary line here")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print passing seeds too")
+    return run_sweep(ap.parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,486 @@
+"""Million-client ingress plane: trace-driven load, admission hardening,
+consistent-hash fleet placement, and the WAN scenario bank.
+
+The claims under test are the ingress plane's contract (ROADMAP item /
+COVERAGE row 44):
+
+* traces are a pure function of (seed, spec) — byte-identical replays;
+* honest (in-rate-limit) clients are NEVER starved, no matter how hard the
+  flood or duplicate-retry storm leans on admission (non-starvation is by
+  construction: honest pacing stays inside the token budget);
+* admission decisions are triple-booked — summary counts, pinned
+  ``ingress_*`` metrics, and the ``admission_overload`` / ``dedup_storm``
+  detectors firing on seeded scenarios while clean soaks stay silent;
+* rendezvous placement moves ONLY ~1/N tenants on a server leave;
+* a real sidecar fleet reroutes a ``TenantAdmissionReject`` to the ring's
+  next candidate (pinned ``ingress_reroute_total``);
+* WAN schedules (``generate(wan=...)``) are deterministic and leave
+  non-WAN schedules byte-identical.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensus_tpu.ingress import (
+    AdmissionController,
+    DedupCache,
+    IngressDriver,
+    PlacementRing,
+    SidecarFleet,
+    TokenBucket,
+    clean_spec,
+    duplicate_storm_spec,
+    flood_spec,
+    generate_trace,
+)
+from consensus_tpu.metrics import (
+    INGRESS_ADMITTED_KEY,
+    INGRESS_DEDUP_HITS_KEY,
+    INGRESS_OFFERED_KEY,
+    INGRESS_RATE_LIMITED_KEY,
+    INGRESS_REROUTE_KEY,
+    InMemoryProvider,
+    Metrics,
+)
+from consensus_tpu.obs.detectors import DetectorBank
+from consensus_tpu.types import RequestInfo
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- admission primitives ---------------------------------------------------
+
+
+def test_token_bucket_refills_at_rate_and_caps_at_burst():
+    tb = TokenBucket(rate=2.0, burst=4.0)
+    # First call starts with a full burst.
+    assert all(tb.allow(0.0) for _ in range(4))
+    assert not tb.allow(0.0)
+    # Half a second refills one token at rate=2.
+    assert tb.allow(0.5)
+    assert not tb.allow(0.5)
+    # A long idle stretch caps at burst, not at elapsed * rate.
+    assert all(tb.allow(100.0) for _ in range(4))
+    assert not tb.allow(100.0)
+
+
+def test_dedup_cache_is_a_bounded_lru_keyed_on_full_request_info():
+    cache = DedupCache(capacity=2)
+    a = RequestInfo(client_id="c1", request_id="r1")
+    b = RequestInfo(client_id="c2", request_id="r1")  # same rid, other client
+    assert not cache.seen(a)
+    assert cache.seen(a)
+    assert not cache.seen(b), "dedup must key on (client, rid), not rid"
+    # Touch a (now MRU), insert a third: b is the LRU evicted.
+    assert cache.seen(a)
+    c = RequestInfo(client_id="c3", request_id="r9")
+    assert not cache.seen(c)
+    assert not cache.seen(b), "evicted entry must be forgotten"
+
+
+def test_admission_checks_dedup_before_the_token_bucket():
+    """A client's own retries must not drain its rate budget: duplicates
+    are absorbed by the cache BEFORE the bucket is consulted."""
+    ctrl = AdmissionController(rate=1.0, burst=2.0)
+    info = RequestInfo(client_id="c", request_id="0")
+    assert ctrl.admit(0.0, info) == "admitted"
+    for _ in range(10):
+        assert ctrl.admit(0.0, info) == "duplicate"
+    # Budget untouched by the retries: one fresh token still there.
+    fresh = RequestInfo(client_id="c", request_id="1")
+    assert ctrl.admit(0.0, fresh) == "admitted"
+    assert ctrl.admit(0.0, RequestInfo("c", "2")) == "rate_limited"
+    assert (ctrl.offered, ctrl.admitted, ctrl.dedup_hits,
+            ctrl.rate_limited) == (13, 2, 10, 1)
+
+
+# --- placement --------------------------------------------------------------
+
+
+def test_placement_is_deterministic_and_order_total():
+    ring = PlacementRing([f"s{i}" for i in range(5)])
+    for tenant in ("t0", "t7", "alpha", ""):
+        first = ring.candidates(tenant)
+        assert ring.candidates(tenant) == first
+        assert sorted(first) == sorted(ring.servers())
+    with pytest.raises(ValueError):
+        PlacementRing().candidates("t0")
+
+
+def test_server_leave_moves_only_its_own_tenants():
+    """The rendezvous property the fleet leans on, pinned: removing one of
+    N servers remaps EXACTLY the tenants whose top candidate it was, and
+    that set is ~1/N of the population."""
+    servers = [f"sidecar-{i}" for i in range(5)]
+    tenants = [f"t{i}" for i in range(500)]
+    ring = PlacementRing(servers)
+    before = ring.assignment_map(tenants)
+    victim = "sidecar-3"
+    ring.remove(victim)
+    after = ring.assignment_map(tenants)
+    moved = {t for t in tenants if before[t] != after[t]}
+    assert moved == {t for t in tenants if before[t] == victim}, (
+        "a leave must move ONLY the departed server's tenants"
+    )
+    n = len(servers)
+    assert 0.5 * len(tenants) / n <= len(moved) <= 2.0 * len(tenants) / n
+    # Survivors keep their relative ranking: re-adding restores the map.
+    ring.add(victim)
+    assert ring.assignment_map(tenants) == before
+
+
+# --- trace generation -------------------------------------------------------
+
+
+def test_traces_are_byte_identical_per_seed_and_seed_sensitive():
+    spec = flood_spec(clients=120, duration=5.0)
+    t1 = generate_trace(11, spec)
+    t2 = generate_trace(11, spec)
+    assert t1 == t2
+    assert t1 != generate_trace(12, spec)
+    assert all(0.0 <= e.t < spec.duration for e in t1)
+    assert all(spec.size_min <= e.size <= spec.size_cap for e in t1)
+
+
+def test_duplicate_storm_reemits_already_sent_flood_requests():
+    spec = duplicate_storm_spec(duration=10.0, clients=100)
+    trace = generate_trace(3, spec)
+    dupes = [e for e in trace if e.duplicate]
+    assert dupes, "the storm window must re-emit requests"
+    fresh = {(e.client, e.rid) for e in trace if not e.duplicate}
+    assert all((d.client, d.rid) in fresh for d in dupes), (
+        "storm events must replay ALREADY-SENT request ids"
+    )
+    assert all(not d.honest for d in dupes)
+
+
+# --- the open-loop driver ---------------------------------------------------
+
+
+def _run(seed, spec, **kw):
+    return IngressDriver(generate_trace(seed, spec), spec, seed=seed, **kw)
+
+
+def test_honest_clients_never_starved_under_flood_and_storm():
+    """The acceptance claim: in-rate-limit clients see zero rejects while
+    the flood cohort is shedding >80% of its offered load."""
+    for spec in (
+        flood_spec(clients=400, duration=10.0),
+        duplicate_storm_spec(duration=10.0, clients=400),
+    ):
+        summary = _run(5, spec).run()
+        assert summary["admitted_honest"] == summary["offered_honest"] > 0
+        assert summary["committed_honest"] == summary["offered_honest"]
+        assert summary["rate_limited"] > 0 or summary["dedup_hits"] > 0
+
+
+def test_ten_thousand_client_replay_is_byte_identical_per_seed():
+    """The scale acceptance gate: a 10k-client heavy-tailed trace against
+    a 4-server hashed fleet, replayed twice, yields byte-identical
+    summaries — and honest clients stay whole at that scale too."""
+    spec = flood_spec(clients=10_000, duration=2.0)
+    trace = generate_trace(42, spec)
+    assert len(trace) > 100_000, "10k clients must offer real load"
+    first = IngressDriver(trace, spec, seed=42, servers=4).summary_json()
+    d2 = IngressDriver(trace, spec, seed=42, servers=4)
+    d2.run()
+    second_run = d2.summary_json()
+    d1 = IngressDriver(trace, spec, seed=42, servers=4)
+    d1.run()
+    assert d1.summary_json() == second_run
+    assert first != second_run  # pre-run summary differs: the run ran
+    summary = d1.summary()
+    assert summary["admitted_honest"] == summary["offered_honest"] > 0
+
+
+def test_flood_fires_admission_overload_and_clean_soak_is_silent():
+    flood = _run(0, flood_spec(clients=300, duration=10.0)).run()
+    assert "admission_overload" in flood["anomalies"]
+    assert "dedup_storm" not in flood["anomalies"]
+    clean = _run(0, clean_spec(clients=300, duration=10.0)).run()
+    assert clean["anomalies"] == {}
+    assert clean["rate_limited"] == 0 and clean["dedup_hits"] == 0
+
+
+def test_duplicate_storm_fires_dedup_storm_detector():
+    summary = _run(1, duplicate_storm_spec(duration=12.0, clients=300)).run()
+    assert "dedup_storm" in summary["anomalies"]
+    assert summary["dedup_hits"] > 0
+
+
+def test_ingress_detectors_ignore_cluster_health_samples():
+    """Cluster health dicts never carry ingress fields; feeding them to the
+    bank must not fire (or even arm) the ingress detectors — existing
+    fixed-seed cluster anomaly streams stay untouched."""
+    bank = DetectorBank()
+    cluster_health = {"running": True, "ledger": 5, "pool": 0, "view": 0}
+    for t in range(1, 50):
+        fired = bank.evaluate(float(t), {1: dict(cluster_health)})
+        assert not any(
+            a.kind in ("admission_overload", "dedup_storm") for a in fired
+        )
+
+
+def test_driver_triple_books_admission_into_pinned_metrics():
+    metrics = Metrics(InMemoryProvider())
+    spec = flood_spec(clients=200, duration=8.0)
+    driver = _run(2, spec, metrics=metrics)
+    summary = driver.run()
+    dump = metrics.provider.dump()
+    assert dump[INGRESS_OFFERED_KEY]["value"] == summary["offered"]
+    assert dump[INGRESS_ADMITTED_KEY]["value"] == summary["admitted"]
+    assert dump[INGRESS_RATE_LIMITED_KEY]["value"] == summary["rate_limited"]
+    assert dump[INGRESS_DEDUP_HITS_KEY]["value"] == summary["dedup_hits"]
+    fired = sum(summary["anomalies"].values())
+    assert fired > 0
+    booked = sum(
+        dump[f"obs_anomaly_{kind}"]["value"]
+        for kind in ("admission_overload", "dedup_storm")
+    )
+    assert booked == fired
+
+
+def test_fleet_queue_limit_reroutes_to_next_ring_candidate():
+    """Sim-fleet twin of the sidecar status-2 reject: a one-slot fleet
+    overflows its primary and the driver walks the ring, booking hops on
+    the pinned reroute counter."""
+    metrics = Metrics(InMemoryProvider())
+    spec = flood_spec(clients=200, duration=8.0)
+    summary = _run(
+        2, spec, metrics=metrics, servers=4, queue_limit=1,
+        service_rate=50.0,
+    ).run()
+    assert summary["reroutes"] > 0
+    dump = metrics.provider.dump()
+    assert dump[INGRESS_REROUTE_KEY]["value"] == summary["reroutes"]
+
+
+# --- real sidecar fleet reroute --------------------------------------------
+
+
+class _GoodEngine:
+    def verify_batch(self, msgs, sigs, keys):
+        return np.array([s == b"good" for s in sigs], dtype=bool)
+
+    def verify_host(self, msgs, sigs, keys):
+        return self.verify_batch(msgs, sigs, keys)
+
+
+def test_real_fleet_reroutes_tenant_admission_reject():
+    """End-to-end over real sockets: server A's tenant queue is too small
+    for the batch, so the placement-aware client hands the batch to the
+    ring's next candidate instead of falling back locally — pinned
+    ``ingress_reroute_total`` counts the hop."""
+    from consensus_tpu.net.sidecar import (
+        SidecarVerifierClient,
+        VerifySidecarServer,
+    )
+
+    tenants = {"alpha": b"alpha-secret"}
+    metrics = Metrics(InMemoryProvider())
+    srv_a = VerifySidecarServer(
+        ("127.0.0.1", 0), _GoodEngine(), tenants=tenants,
+        wave_window=0.02, tenant_queue_limit=16,
+    )
+    srv_b = VerifySidecarServer(
+        ("127.0.0.1", 0), _GoodEngine(), tenants=tenants,
+        wave_window=0.02, tenant_queue_limit=1024,
+    )
+    srv_a.start()
+    srv_b.start()
+    fleet = SidecarFleet(
+        {"srv-a": srv_a.address, "srv-b": srv_b.address},
+        client_factory=lambda addr: SidecarVerifierClient(
+            addr, auth_secret=tenants["alpha"], tenant="alpha",
+        ),
+        metrics=metrics.ingress,
+    )
+    client = SidecarVerifierClient(
+        srv_a.address, auth_secret=tenants["alpha"], tenant="alpha",
+        fleet=fleet, fleet_id="srv-a",
+    )
+    try:
+        out = client.verify_batch([b"m"] * 20, [b"good"] * 20, [b"k"] * 20)
+        assert out.all() and len(out) == 20
+        assert fleet.reroutes == [("alpha", "srv-a", "srv-b")]
+        dump = metrics.provider.dump()
+        assert dump[INGRESS_REROUTE_KEY]["value"] == 1
+        assert not client._suspect, "admission reject must not mark suspect"
+    finally:
+        client.close()
+        fleet.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+# --- WAN scenario bank ------------------------------------------------------
+
+
+def test_wan_schedules_are_deterministic_and_opt_in():
+    from consensus_tpu.testing.chaos import ChaosSchedule, WAN_PROFILES
+
+    base = ChaosSchedule.generate(7, steps=12)
+    assert ChaosSchedule.generate(7, steps=12, wan=None) == base, (
+        "wan=None must consume no RNG: pre-WAN schedules replay unchanged"
+    )
+    for profile in WAN_PROFILES:
+        s1 = ChaosSchedule.generate(7, steps=12, wan=profile)
+        assert s1 == ChaosSchedule.generate(7, steps=12, wan=profile)
+        assert s1.wan == profile
+    with pytest.raises(ValueError):
+        ChaosSchedule.generate(7, wan="atlantis")
+
+
+def test_region_partition_groups_match_the_geography():
+    from consensus_tpu.testing.chaos import ChaosSchedule, region_map
+
+    found = None
+    for seed in range(40):
+        sched = ChaosSchedule.generate(seed, steps=12, wan="3region")
+        for a in sched.actions:
+            if a.kind == "region_partition":
+                found = (sched, a)
+                break
+        if found:
+            break
+    assert found, "40 seeds of 12 steps must draw one region_partition"
+    sched, action = found
+    rmap = region_map("3region", range(1, sched.n + 1))
+    expect = tuple(sorted(
+        i for i in range(1, sched.n + 1)
+        if rmap[i] == action.args["region"]
+    ))
+    assert action.args["group"] == expect
+
+
+def test_wan_chaos_run_is_safe_and_replay_identical():
+    """Tier-1 WAN smoke: a geography-pinned schedule (jittered links,
+    region cuts, leader shifts) runs clean and byte-identically twice."""
+    from consensus_tpu.testing.chaos import ChaosEngine, ChaosSchedule
+
+    sched = ChaosSchedule.generate(7, steps=8, wan="3region")
+    r1 = ChaosEngine(sched).run()
+    assert r1.ok, r1.violation
+    r2 = ChaosEngine(sched).run()
+    assert r1.event_log == r2.event_log
+    assert r1.ledgers == r2.ledgers
+
+
+def test_wan_links_cover_every_ordered_pair():
+    from consensus_tpu.testing.chaos import WAN_PROFILES, wan_links
+
+    for profile in WAN_PROFILES:
+        links = wan_links(profile, [1, 2, 3, 4, 5])
+        assert len(links) == 20  # 5 * 4 ordered pairs
+        assert all(base > 0 and jitter >= 0 for _, _, base, jitter in links)
+
+
+def test_format_repro_carries_the_wan_profile():
+    from consensus_tpu.testing.chaos import (
+        ChaosEngine, ChaosSchedule, format_repro,
+    )
+
+    sched = ChaosSchedule.generate(3, steps=4, wan="2region-lopsided")
+    snippet = format_repro(ChaosEngine(sched).run())
+    assert "wan='2region-lopsided'" in snippet
+
+
+# --- network jitter knob ----------------------------------------------------
+
+
+def _two_node_net(seed=0):
+    from consensus_tpu.runtime.scheduler import SimScheduler
+    from consensus_tpu.testing.network import SimNetwork
+
+    sched = SimScheduler()
+    net = SimNetwork(sched, seed=seed)
+    arrivals = []
+    net.register(1, lambda s, p, r: None)
+    net.register(2, lambda s, p, r: arrivals.append(sched.now()))
+    return sched, net, arrivals
+
+
+def test_set_jitter_draws_within_the_distribution_and_heals_away():
+    sched, net, arrivals = _two_node_net()
+    net.set_jitter(1, 2, 0.1, 0.05)
+    for _ in range(20):
+        net.send(1, 2, b"x", is_request=False)
+    sched.run_until_idle()
+    assert len(arrivals) == 20
+    assert all(0.1 <= t <= 0.15 + 1e-9 for t in arrivals)
+    assert len(set(arrivals)) > 1, "spread must actually spread"
+    # set_delay composes by max: a floor above the distribution wins.
+    arrivals.clear()
+    net.set_delay(1, 2, 0.5)
+    net.send(1, 2, b"x", is_request=False)
+    sched.run_until_idle()
+    assert arrivals[-1] - sched.now() <= 0 and arrivals[-1] >= 0.5
+    # heal() clears jitter along with every other knob.
+    arrivals.clear()
+    net.heal()
+    base = sched.now()
+    net.send(1, 2, b"x", is_request=False)
+    sched.run_until_idle()
+    assert arrivals == [base + net.default_delay]
+
+
+def test_unarmed_jitter_consumes_no_rng():
+    """Arming a zero-spread jitter link must not shift the loss stream on
+    other links — the byte-identity discipline for non-WAN schedules."""
+    outcomes = []
+    for arm in (False, True):
+        sched, net, arrivals = _two_node_net(seed=9)
+        if arm:
+            net.set_jitter(1, 2, 0.01, 0.0)  # spread 0: no draw
+        net.set_loss(2, 1, 0.5)
+        net.register(3, lambda s, p, r: None)
+        for _ in range(30):
+            net.send(2, 1, b"y", is_request=False)
+        sched.run_until_idle()
+        outcomes.append(net.injected["dropped"])
+    assert outcomes[0] == outcomes[1]
+
+
+# --- the sweep scripts ------------------------------------------------------
+
+
+def _run_script(script, *argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", script), *argv],
+        capture_output=True, text=True, cwd=_REPO, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
+def test_ingress_sweep_emits_per_seed_and_summary_json(tmp_path):
+    out = tmp_path / "sweep.json"
+    proc = _run_script(
+        "ingress_sweep.py", "--count", "2", "--clients", "150",
+        "--duration", "6", "--scenario", "flood", "--json-out", str(out),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(l) for l in proc.stdout.splitlines()
+             if l.startswith("{")]
+    assert len(lines) == 3  # 2 per-seed + 1 summary
+    assert all(l["ok"] for l in lines[:2])
+    summary = lines[-1]
+    assert summary["swept"] == 2 and summary["failed"] == 0
+    assert summary["params"]["scenario"] == "flood"
+    assert "admission_overload" in summary["anomalies"]
+    assert json.loads(out.read_text())["swept"] == 2
+
+
+def test_chaos_sweep_accepts_wan_profile():
+    proc = _run_script(
+        "chaos_sweep.py", "--start", "7", "--count", "1",
+        "--steps", "6", "--wan", "3region",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout.splitlines()[-1])
+    assert summary["failed"] == 0
+    assert summary["params"]["wan"] == "3region"

@@ -100,6 +100,26 @@ def test_lint_covers_fused_pipeline():
         )
 
 
+def test_lint_covers_ingress_plane():
+    """The ingress plane (workload/admission/placement/driver) promises
+    byte-identical same-seed trace replays and summaries; a wall-clock
+    read in any of them breaks that exactly like one in the obs plane.
+    Pin the lint's coverage of consensus_tpu/ingress/ — presence of the
+    expected modules first, then a walk rooted at the tree."""
+    ingress_dir = os.path.join(_REPO, "consensus_tpu", "ingress")
+    present = {f for f in os.listdir(ingress_dir) if f.endswith(".py")}
+    assert {"workload.py", "admission.py",
+            "placement.py", "driver.py"} <= present
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, ingress_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        "ingress plane has wall-clock reads:\n" + proc.stdout + proc.stderr
+    )
+
+
 def test_lint_covers_models_aggregate():
     """Half-aggregation (models/aggregate.py) derives its Fiat-Shamir
     coefficients from a deterministic transcript — a wall-clock read

@@ -194,12 +194,15 @@ def test_leader_churn_schedule_fires_storm_and_flap_detectors():
     assert result.ok, result.violation
     counts = engine.cluster.sampler.anomaly_counts()
     assert {"view_change_storm", "leader_flap"} <= set(counts)
-    # Together with the partition schedule and the churn chaos run
-    # (tests/test_membership.py fires membership_churn end-to-end), the
-    # full detector matrix fires.
+    # Together with the partition schedule, the churn chaos run
+    # (tests/test_membership.py fires membership_churn end-to-end), and the
+    # ingress scenarios (tests/test_ingress.py fires admission_overload and
+    # dedup_storm end-to-end), the full detector matrix fires.
     partition_kinds = {"commit_stall", "sync_lag", "verify_collapse"}
     churn_kinds = {"membership_churn"}
-    assert partition_kinds | churn_kinds | set(counts) >= set(ANOMALY_KINDS)
+    ingress_kinds = {"admission_overload", "dedup_storm"}
+    assert (partition_kinds | churn_kinds | ingress_kinds | set(counts)
+            >= set(ANOMALY_KINDS))
 
 
 def test_detector_firings_are_deterministic():
