@@ -144,6 +144,27 @@ def bench_batch_verify(msgs, sigs, keys) -> float:
     return len(msgs) * DEVICE_ITERS / (time.perf_counter() - start)
 
 
+def bench_supervised_verify(msgs, sigs, keys) -> float:
+    """``supervised_verify`` column: the strict engine under an
+    :class:`~consensus_tpu.models.supervisor.EngineSupervisor` (breaker
+    closed, cross-check off — the healthy-path configuration), timed
+    through ``verify_batch``.  The column answers "what does the
+    supervision layer cost when nothing is wrong": the wrapper adds one
+    lock acquire, a breaker/ladder check, and a counter bump per launch,
+    so ``vs_strict`` should stay ~1.0 — a drift means the supervisor grew
+    hot-path work."""
+    from consensus_tpu.models import Ed25519BatchVerifier, EngineSupervisor
+
+    verifier = EngineSupervisor([Ed25519BatchVerifier()], name="bench")
+    ok = verifier.verify_batch(msgs, sigs, keys)  # warmup (cached compile)
+    assert ok.all(), "benchmark signatures must verify"
+    start = time.perf_counter()
+    for _ in range(DEVICE_ITERS):
+        ok = verifier.verify_batch(msgs, sigs, keys)
+        assert ok.all()
+    return len(msgs) * DEVICE_ITERS / (time.perf_counter() - start)
+
+
 def bench_fused_verify(msgs, sigs, keys) -> float:
     """``fused_verify`` column: the bytes-in → verdict-out engine
     (models/fused.py) timed through ``verify_stream`` so host byte-slicing
@@ -721,6 +742,7 @@ def main() -> None:
 
     backend = jax.default_backend()
     batch_verify_rate = None
+    supervised_rate = None
     fused_verify_rate = None
     breakdown_record = None
     mesh_record = None
@@ -748,6 +770,12 @@ def main() -> None:
                 batch_verify_rate,
                 batch_verify_rate / device_rate,
             )
+            supervised_rate = bench_supervised_verify(msgs, sigs, keys)
+            _save_last_good(
+                "ed25519_supervised_verify_throughput",
+                supervised_rate,
+                supervised_rate / device_rate,
+            )
             mesh_record = bench_mesh_verify(msgs, sigs, keys)
             _save_last_good(
                 "ed25519_mesh_verify_throughput",
@@ -766,6 +794,12 @@ def main() -> None:
             "value": round(batch_verify_rate, 1),
             "unit": "sigs/sec",
             "vs_strict": round(batch_verify_rate / device_rate, 3),
+        }
+    if supervised_rate is not None:
+        record["supervised_verify"] = {
+            "value": round(supervised_rate, 1),
+            "unit": "sigs/sec",
+            "vs_strict": round(supervised_rate / device_rate, 3),
         }
     if fused_verify_rate is not None:
         record["fused_verify"] = {

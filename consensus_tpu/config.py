@@ -157,6 +157,20 @@ class Configuration:
     # shard counts freely — sharding changes only the launch topology, never
     # the verdict (the host-mesh parity gate pins this).
     mesh_shards: int = 1
+    # Engine supervision (models/supervisor.py): wrap the configured engine
+    # in an EngineSupervisor — fault-classed circuit breakers (launch
+    # timeout / launch raise / wrong answer) over an explicit degrade
+    # ladder (fused → unfused device → host twin; N mesh shards → single
+    # device → host) with automatic re-promotion.  Like mesh_shards and
+    # device_prep this changes only WHERE verification runs, never the
+    # verdict (a degraded rung and the host twin are verdict-identical —
+    # SAFETY.md §12), so replicas may differ freely.
+    engine_supervision: bool = False
+    # Sampled host cross-check cadence under supervision: every k-th launch
+    # is recomputed on the big-int host twin and a contradiction trips the
+    # wrong-answer breaker (0 = off).  Launch-counter based, never random,
+    # so fixed-seed runs cross-check identical launches every replay.
+    engine_crosscheck_interval: int = 0
 
     # --- membership epochs (no reference counterpart) -------------------
     # Stamp outbound consensus traffic with the sender's membership epoch
@@ -229,6 +243,12 @@ class Configuration:
             errs.append("pipeline_depth must be >= 1")
         if self.mesh_shards < 1:
             errs.append("mesh_shards must be >= 1")
+        if self.engine_crosscheck_interval < 0:
+            errs.append("engine_crosscheck_interval must be >= 0")
+        if self.engine_crosscheck_interval and not self.engine_supervision:
+            errs.append(
+                "engine_crosscheck_interval requires engine_supervision"
+            )
         if self.cert_mode not in ("full", "half-agg"):
             errs.append('cert_mode must be "full" or "half-agg"')
         if self.crypto_tpu_min_batch < 1:

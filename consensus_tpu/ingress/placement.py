@@ -102,9 +102,34 @@ class SidecarFleet:
         self.tracer = tracer
         #: (tenant, from_server, to_server) reroute hops, in order.
         self.reroutes: list[tuple[str, str, str]] = []
+        #: Servers currently answering with status 3 (their supervised
+        #: engine is below its top rung).  Fed by
+        #: :class:`~consensus_tpu.net.sidecar.SidecarVerifierClient` at
+        #: response time; cleared by the first status-0 answer.
+        self._degraded: set[str] = set()
 
     def candidates(self, tenant: Optional[str]) -> list[str]:
-        return self.ring.candidates(tenant or "")
+        """Rendezvous order, but NON-DEGRADED servers first: a degraded
+        server still serves correct verdicts (its supervisor's host twin is
+        ground truth), so it stays a candidate — just the last resort.  The
+        sort is stable, so within each health class the deterministic ring
+        order is preserved."""
+        order = self.ring.candidates(tenant or "")
+        if not self._degraded:
+            return order
+        return sorted(order, key=lambda s: s in self._degraded)
+
+    def note_degraded(self, server_id: str, degraded: bool = True) -> None:
+        """Record ``server_id``'s engine health as seen on the wire (the
+        status byte of its last verify answer).  Unknown ids are accepted —
+        health is an observation, not a membership operation."""
+        if degraded:
+            self._degraded.add(server_id)
+        else:
+            self._degraded.discard(server_id)
+
+    def is_degraded(self, server_id: str) -> bool:
+        return server_id in self._degraded
 
     def assign(self, tenant: Optional[str]) -> str:
         return self.ring.assign(tenant or "")

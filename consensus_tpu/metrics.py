@@ -51,6 +51,7 @@ OBS_ANOMALY_VERIFY_COLLAPSE_KEY = "obs_anomaly_verify_collapse"
 OBS_ANOMALY_MEMBERSHIP_CHURN_KEY = "obs_anomaly_membership_churn"
 OBS_ANOMALY_ADMISSION_OVERLOAD_KEY = "obs_anomaly_admission_overload"
 OBS_ANOMALY_DEDUP_STORM_KEY = "obs_anomaly_dedup_storm"
+OBS_ANOMALY_ENGINE_DEGRADED_KEY = "obs_anomaly_engine_degraded"
 OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_COMMIT_STALL_KEY,
     OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
@@ -60,6 +61,7 @@ OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_MEMBERSHIP_CHURN_KEY,
     OBS_ANOMALY_ADMISSION_OVERLOAD_KEY,
     OBS_ANOMALY_DEDUP_STORM_KEY,
+    OBS_ANOMALY_ENGINE_DEGRADED_KEY,
 )
 
 #: Pinned instrument names for the membership-epoch subsystem
@@ -142,6 +144,27 @@ CERT_KEYS = (
     CERT_FALLBACK_BISECTIONS_KEY,
 )
 
+#: Pinned instrument names for the engine supervision layer
+#: (consensus_tpu/models/supervisor.py).  Every degrade/recover transition
+#: is triple-booked: one of these counters, an ``engine.degrade`` /
+#: ``engine.recover`` trace instant, and the ``engine_degraded`` obs
+#: detector.  Per-fault-class degrade series are children of the pinned
+#: degrade name (``with_labels(reason)`` -> ``engine_degrade_total{reason}``
+#: in the in-memory provider), so the aggregate name stays stable for
+#: dashboards while the chaos matrix can read one fault class out.
+ENGINE_DEGRADE_KEY = "engine_degrade_total"
+ENGINE_RECOVERED_KEY = "engine_recovered_total"
+ENGINE_CROSSCHECK_KEY = "engine_crosscheck_total"
+ENGINE_CROSSCHECK_MISMATCH_KEY = "engine_crosscheck_mismatch_total"
+ENGINE_RUNG_KEY = "engine_rung"
+ENGINE_KEYS = (
+    ENGINE_DEGRADE_KEY,
+    ENGINE_RECOVERED_KEY,
+    ENGINE_CROSSCHECK_KEY,
+    ENGINE_CROSSCHECK_MISMATCH_KEY,
+    ENGINE_RUNG_KEY,
+)
+
 #: THE module-level registry of every pinned instrument name: key -> one-line
 #: description.  Tests and embedder dashboards key on this mapping; every
 #: name here is created by a fresh ``Metrics`` bundle (asserted by
@@ -174,6 +197,9 @@ PINNED_METRIC_KEYS: dict[str, str] = {
         "offered ingress load",
     OBS_ANOMALY_DEDUP_STORM_KEY:
         "detector firings: dedup cache absorbing a duplicate-retry storm",
+    OBS_ANOMALY_ENGINE_DEGRADED_KEY:
+        "detector firings: a supervised verify engine running below its "
+        "configured rung",
     INGRESS_OFFERED_KEY:
         "client requests offered to the ingress admission layer",
     INGRESS_ADMITTED_KEY:
@@ -221,6 +247,16 @@ PINNED_METRIC_KEYS: dict[str, str] = {
         "half-aggregated cert checks (one MSM launch each)",
     CERT_FALLBACK_BISECTIONS_KEY:
         "cert aggregations abandoned to bisection + full-tuple fallback",
+    ENGINE_DEGRADE_KEY:
+        "supervised engine degrades down the ladder (per-reason children)",
+    ENGINE_RECOVERED_KEY:
+        "supervised engine re-promotions after a breaker re-closed",
+    ENGINE_CROSSCHECK_KEY:
+        "sampled host cross-checks run against device verdicts",
+    ENGINE_CROSSCHECK_MISMATCH_KEY:
+        "host cross-checks that contradicted the device verdict",
+    ENGINE_RUNG_KEY:
+        "current degrade-ladder rung (0 = as configured; gauge)",
 }
 
 
@@ -312,10 +348,12 @@ class NoopProvider(Provider):
 
 class _MemInstrument(Counter, Gauge, Histogram):
     def __init__(self, provider: "InMemoryProvider", name: str,
-                 label_names: tuple[str, ...] = ()) -> None:
+                 label_names: tuple[str, ...] = (),
+                 bound_tail: tuple[str, ...] = ()) -> None:
         self._provider = provider
         self._name = name
         self.label_names = label_names
+        self._bound_tail = bound_tail
         self.value = 0.0
         self.observations: list[float] = []
 
@@ -330,16 +368,29 @@ class _MemInstrument(Counter, Gauge, Histogram):
 
     def with_labels(self, *values: str) -> "_MemInstrument":
         """A child instrument keyed ``name{v1,v2}`` — one series per label
-        value set, like a Prometheus vector."""
-        if len(values) != len(self.label_names):
+        value set, like a Prometheus vector.  Binding fewer values than
+        label names binds the TRAILING names (the embedder extras
+        ``extend_label_names`` appends): ``_Bundle.with_labels`` can bind
+        the channel dimension first and the instrument's owner binds its
+        own leading labels (e.g. ``reason``) later."""
+        if len(values) > len(self.label_names):
             raise ValueError(
                 f"{self._name}: {len(self.label_names)} label(s) expected, "
                 f"got {len(values)}"
             )
         if not values:
             return self
+        if len(values) < len(self.label_names):
+            # Partial bind — not a series yet, so not registered with the
+            # provider; the final child is created on the full bind below.
+            return _MemInstrument(
+                self._provider, self._name,
+                self.label_names[: len(self.label_names) - len(values)],
+                tuple(values) + self._bound_tail,
+            )
         return self._provider._get(
-            "%s{%s}" % (self._name, ",".join(values)), ()
+            "%s{%s}" % (self._name,
+                        ",".join(tuple(values) + self._bound_tail)), ()
         )
 
 
@@ -681,6 +732,12 @@ class MetricsObs(_Bundle):
             "Ingress duplicate-retry-storm detector firings.",
             ln,
         )
+        self.count_anomaly_engine_degraded = p.new_counter(
+            OBS_ANOMALY_ENGINE_DEGRADED_KEY,
+            "Engine-degraded detector firings (supervised engine below its "
+            "configured rung).",
+            ln,
+        )
 
     def anomaly_counter(self, kind: str) -> Counter:
         """The pinned counter for detector ``kind`` (its short name, e.g.
@@ -812,6 +869,43 @@ class MetricsIngress(_Bundle):
         )
 
 
+class MetricsEngine(_Bundle):
+    """Engine-supervision instruments — consensus_tpu addition, fed by
+    ``models.supervisor.EngineSupervisor``.  Per-fault-class degrade series
+    are children of the pinned degrade name (``with_labels(reason)`` ->
+    ``engine_degrade_total{reason}`` in the in-memory provider); the rung
+    gauge tracks where on the ladder the supervisor is currently serving
+    (0 = as configured, last rung = host twin)."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_degrade = p.new_counter(
+            ENGINE_DEGRADE_KEY,
+            "Supervised engine degrades down the ladder.",
+            extend_label_names(("reason",), label_names),
+        )
+        self.count_recovered = p.new_counter(
+            ENGINE_RECOVERED_KEY,
+            "Supervised engine re-promotions after a breaker re-closed.",
+            ln,
+        )
+        self.count_crosscheck = p.new_counter(
+            ENGINE_CROSSCHECK_KEY,
+            "Sampled host cross-checks run against device verdicts.",
+            ln,
+        )
+        self.count_crosscheck_mismatch = p.new_counter(
+            ENGINE_CROSSCHECK_MISMATCH_KEY,
+            "Host cross-checks that contradicted the device verdict.",
+            ln,
+        )
+        self.rung = p.new_gauge(
+            ENGINE_RUNG_KEY,
+            "Current degrade-ladder rung (0 = as configured).",
+            ln,
+        )
+
+
 class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
@@ -852,6 +946,7 @@ class Metrics:
         self.membership = MetricsMembership(provider, label_names)
         self.sidecar = MetricsSidecar(provider, label_names)
         self.ingress = MetricsIngress(provider, label_names)
+        self.engine = MetricsEngine(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
         """Bind embedder label values on every bundle (e.g. the channel id).
@@ -887,6 +982,7 @@ __all__ = [
     "MetricsMembership",
     "MetricsSidecar",
     "MetricsIngress",
+    "MetricsEngine",
     "extend_label_names",
     "VERIFY_LAUNCH_BATCH_KEY",
     "WAL_RECORDS_PER_FSYNC_KEY",
@@ -904,6 +1000,7 @@ __all__ = [
     "OBS_ANOMALY_MEMBERSHIP_CHURN_KEY",
     "OBS_ANOMALY_ADMISSION_OVERLOAD_KEY",
     "OBS_ANOMALY_DEDUP_STORM_KEY",
+    "OBS_ANOMALY_ENGINE_DEGRADED_KEY",
     "OBS_ANOMALY_KEYS",
     "INGRESS_OFFERED_KEY",
     "INGRESS_ADMITTED_KEY",
@@ -932,5 +1029,11 @@ __all__ = [
     "CERT_AGGREGATE_LAUNCHES_KEY",
     "CERT_FALLBACK_BISECTIONS_KEY",
     "CERT_KEYS",
+    "ENGINE_DEGRADE_KEY",
+    "ENGINE_RECOVERED_KEY",
+    "ENGINE_CROSSCHECK_KEY",
+    "ENGINE_CROSSCHECK_MISMATCH_KEY",
+    "ENGINE_RUNG_KEY",
+    "ENGINE_KEYS",
     "PINNED_METRIC_KEYS",
 ]

@@ -7,6 +7,16 @@ from consensus_tpu.models.ed25519 import (
     L,
 )
 from consensus_tpu.models.engine import BatchCoalescer, ThreadCoalescingVerifier
+from consensus_tpu.models.supervisor import (
+    ENGINE_HEALTH,
+    FAULT_CLASSES,
+    CircuitBreaker,
+    EngineHealth,
+    EngineHealthRegistry,
+    EngineSupervisor,
+    HostTwin,
+    LaunchTimeout,
+)
 from consensus_tpu.models.fused import (
     FusedEd25519BatchVerifier,
     FusedEd25519RandomizedBatchVerifier,
@@ -17,6 +27,7 @@ from consensus_tpu.models.verifier import (
     Ed25519Signer,
     Ed25519VerifierMixin,
     commit_message,
+    degrade_ladder_configs,
     engine_for_config,
     raw_message,
 )
@@ -32,9 +43,18 @@ __all__ = [
     "L",
     "BatchCoalescer",
     "ThreadCoalescingVerifier",
+    "CircuitBreaker",
+    "ENGINE_HEALTH",
+    "EngineHealth",
+    "EngineHealthRegistry",
+    "EngineSupervisor",
+    "FAULT_CLASSES",
+    "HostTwin",
+    "LaunchTimeout",
     "Ed25519Signer",
     "Ed25519VerifierMixin",
     "commit_message",
+    "degrade_ladder_configs",
     "engine_for_config",
     "raw_message",
 ]

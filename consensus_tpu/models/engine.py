@@ -19,6 +19,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from consensus_tpu.models.supervisor import ENGINE_HEALTH, EngineHealth
 from consensus_tpu.runtime.scheduler import Scheduler, TimerHandle
 
 logger = logging.getLogger("consensus_tpu.models.engine")
@@ -168,6 +169,8 @@ class ThreadCoalescingVerifier:
         hard_cap: int = 0,
         bypass_below: int = 0,
         wait_timeout: Optional[float] = None,
+        scheduler: Optional[Scheduler] = None,
+        health: Optional[EngineHealth] = None,
         name: str = "verify-coalescer",
     ) -> None:
         self._engine = engine
@@ -188,7 +191,23 @@ class ThreadCoalescingVerifier:
         self._pending: list[_Pending] = []
         self._count = 0
         self._closed = False
-        self._device_suspect = False
+        # Suspect state is SHARED across every coalescer (and tenant lane)
+        # wrapping the same engine: a wedge seen by one waiter routes all
+        # of them host-side.  An engine carrying its own health surface
+        # (e.g. an EngineSupervisor) contributes it; otherwise the
+        # process-wide registry keys one per engine instance.
+        if health is None:
+            health = getattr(engine, "health", None)
+            if not isinstance(health, EngineHealth):
+                health = ENGINE_HEALTH.for_engine(engine)
+        self._health = health
+        # Suspect re-probe pacing: protocol-clocked when the embedder hands
+        # us its scheduler; only the real-thread sidecar path (no scheduler
+        # available) reads the wall clock.
+        if scheduler is not None:
+            self._probe_clock = scheduler.now
+        else:
+            self._probe_clock = time.monotonic  # wallclock-ok
         self._probe_interval = 30.0
         self._last_probe = -float("inf")
         self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
@@ -198,7 +217,16 @@ class ThreadCoalescingVerifier:
     def device_suspect(self) -> bool:
         """True while the device is considered wedged (submissions are
         routed straight to the host path)."""
-        return self._device_suspect
+        return self._health.suspect
+
+    @property
+    def health(self) -> EngineHealth:
+        """The shared engine-health entry this coalescer reports into."""
+        return self._health
+
+    @property
+    def _device_suspect(self) -> bool:
+        return self._health.suspect
 
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         n = len(messages)
@@ -245,6 +273,15 @@ class ThreadCoalescingVerifier:
                 self._abandon_to_host(items)
                 break
             if item.error is not None:
+                if self._host_fallback is not None:
+                    # A flush error with a host twin available is a degrade,
+                    # not a decision-killer: mark the device suspect and
+                    # complete the wave on the caller's thread via host
+                    # (mirrors the timeout path above — errors reaching a
+                    # waiter here mean the flusher's own host attempt hit a
+                    # transient, so retry it where the waiter can see it).
+                    self._abandon_to_host(items, reason="launch_raise")
+                    break
                 # A merged flush fails for every waiter; raising the SAME
                 # exception object from N threads would interleave their
                 # frames into one shared traceback — wrap per waiter.
@@ -260,8 +297,10 @@ class ThreadCoalescingVerifier:
         so the flusher (once it unwedges / recovers) runs a device flush and
         clears the flag.  At most one probe is queued at a time, and probes
         are rate-limited — a stuck flusher can't accumulate a backlog."""
-        # Real-thread probe rate limit: this path runs outside the scheduler.
-        now = time.monotonic()  # wallclock-ok
+        # Probe pacing through the injected clock (scheduler.now when the
+        # embedder provided one; the real-thread sidecar path falls back to
+        # the audited wall clock chosen in __init__).
+        now = self._probe_clock()
         with self._cv:
             if (
                 self._closed
@@ -281,7 +320,9 @@ class ThreadCoalescingVerifier:
             self._count += cap
             self._cv.notify_all()
 
-    def _abandon_to_host(self, items: list["_Pending"]) -> None:
+    def _abandon_to_host(
+        self, items: list["_Pending"], reason: str = "launch_timeout"
+    ) -> None:
         """Waiter-side escape hatch: the flush never completed within
         ``wait_timeout`` (hung device call, e.g. a wedged TPU tunnel).
         Mark the device suspect, pull any chunks still queued out of the
@@ -290,14 +331,13 @@ class ThreadCoalescingVerifier:
         protocol timeouts.  Results the stuck flusher produces later for
         these items are simply ignored."""
         with self._cv:
-            if not self._device_suspect:
+            if self._health.mark_suspect(reason):
                 logger.error(
-                    "verify flush did not complete within %.1fs — device "
-                    "suspect; falling back to HOST verification (slower, "
-                    "still correct) until a device flush succeeds",
-                    self._wait_timeout,
+                    "verify flush did not complete (%s) — device suspect; "
+                    "falling back to HOST verification (slower, still "
+                    "correct) until a device flush succeeds",
+                    reason,
                 )
-            self._device_suspect = True
             for item in items:
                 if item in self._pending:
                     self._pending.remove(item)
@@ -379,8 +419,7 @@ class ThreadCoalescingVerifier:
                         exc,
                         len(messages),
                     )
-                    with self._cv:
-                        self._device_suspect = True
+                    self._health.mark_suspect("launch_raise")
                     for item in batch:
                         if item.waiterless:
                             item.done.set()  # failed probe: nothing to serve
@@ -402,13 +441,11 @@ class ThreadCoalescingVerifier:
                     item.error = exc
                     item.done.set()
                 continue
-            if self._device_suspect:
+            if self._health.clear():
                 logger.warning(
                     "device verify flush succeeded — clearing suspect flag, "
                     "resuming device batching"
                 )
-                with self._cv:
-                    self._device_suspect = False
             for item, piece in zip(batch, slices):
                 item.result = piece
                 item.done.set()

@@ -57,7 +57,44 @@ def engine_for_config(config, curve: str = "ed25519"):
     replica in a cluster must agree on the VERDICT-affecting knobs
     (``batch_verify_mode``, the curve) — verdict parity across replicas is
     a quorum-safety requirement; ``mesh_shards`` and ``device_prep`` only
-    change the launch topology and may differ per replica."""
+    change the launch topology and may differ per replica.
+
+    ``engine_supervision`` wraps the result in an
+    :class:`~consensus_tpu.models.supervisor.EngineSupervisor` over the
+    config's degrade ladder (:func:`degrade_ladder_configs`): fault-classed
+    circuit breakers route launches down fused → unfused → host (and
+    N shards → single device → host) and re-promote when the breaker
+    closes.  Supervision, too, changes only WHERE work runs — never the
+    verdict — so it is per-replica free."""
+    if not getattr(config, "engine_supervision", False):
+        return _engine_for_config(config, curve)
+    from consensus_tpu.models.supervisor import EngineSupervisor
+
+    rungs = [_engine_for_config(c, curve) for c in degrade_ladder_configs(config)]
+    return EngineSupervisor(
+        rungs,
+        crosscheck_interval=int(
+            getattr(config, "engine_crosscheck_interval", 0) or 0
+        ),
+        name=f"{curve}-engine",
+    )
+
+
+def degrade_ladder_configs(config) -> list:
+    """The best-first ``Configuration`` ladder supervision degrades down:
+    as configured, then N mesh shards → single device, then fused → unfused
+    host-prep.  (The host twin is not a config — the supervisor appends it
+    as the ladder's floor itself.)"""
+    ladder = [config]
+    if int(getattr(config, "mesh_shards", 1) or 1) > 1:
+        ladder.append(ladder[-1].with_(mesh_shards=1))
+    if bool(getattr(config, "device_prep", False)):
+        ladder.append(ladder[-1].with_(device_prep=False))
+    return ladder
+
+
+def _engine_for_config(config, curve: str = "ed25519"):
+    """The unsupervised engine routing (see :func:`engine_for_config`)."""
     randomized = bool(getattr(config, "batch_verify_mode", False))
     fused = bool(getattr(config, "device_prep", False))
     shards = int(getattr(config, "mesh_shards", 1) or 1)

@@ -43,6 +43,11 @@ Response payload: u8 status | body
     status 0: count result bytes
     status 1: utf-8 error text
     status 2: u32 queue_depth | u32 limit | utf-8 tenant  (admission reject)
+    status 3: count result bytes, served by a DEGRADED engine (the server's
+              supervised verifier is below its top ladder rung — verdicts
+              are still ground-truth correct, but a fleet-aware client
+              deprioritizes this server on the placement ring until a
+              status-0 answer clears it)
 
 Addresses: a ``(host, port)`` tuple serves TCP (cross-container), a string
 serves a unix domain socket (same-host, lower latency — the common shape).
@@ -529,7 +534,16 @@ class VerifySidecarServer:
             results = np.asarray(self._verify(tenant, messages, signatures, keys))
             if len(results) != len(messages):
                 raise ValueError("engine returned wrong result count")
-            body = b"\x00" + np.asarray(results, dtype=np.uint8).tobytes()
+            # Degraded-health surfacing: sampled at answer time so the
+            # status tracks the supervisor's CURRENT rung (and the
+            # coalescer's suspect flag), not the state when the request
+            # was queued.
+            degraded = bool(
+                getattr(self._engine, "degraded", False)
+                or getattr(self._engine, "device_suspect", False)
+            )
+            status = b"\x03" if degraded else b"\x00"
+            body = status + np.asarray(results, dtype=np.uint8).tobytes()
         except _AdmissionReject as rej:
             # Structured, immediate, and NOT an error to log at exception
             # level: the tenant is over quota, the service is fine.
@@ -958,8 +972,16 @@ class SidecarVerifierClient:
             raise TenantAdmissionReject(
                 body[9:].decode(errors="replace"), depth, limit
             )
-        if body[0] != 0:
+        if body[0] == 1:
             raise RuntimeError(f"sidecar error: {body[1:].decode(errors='replace')}")
+        if body[0] not in (0, 3):
+            raise RuntimeError(f"unknown sidecar status byte {body[0]}")
+        if self._fleet is not None and self._fleet_id is not None:
+            # Status 3: results from a DEGRADED engine — verdicts are
+            # correct (the supervisor's host twin is ground truth) but the
+            # ring should steer reroutes at healthy peers first; a status-0
+            # answer means the supervisor re-promoted, clearing the mark.
+            self._fleet.note_degraded(self._fleet_id, body[0] == 3)
         results = np.frombuffer(body[1:], dtype=np.uint8).astype(bool)
         if len(results) != len(messages):
             raise ValueError("sidecar returned wrong result count")

@@ -6,7 +6,7 @@ fixed-seed run is byte-identical across replays.  Detectors are
 EDGE-TRIGGERED: a condition fires once at onset and re-arms only after the
 condition clears, so a 300-second stall is one anomaly, not 300.
 
-The eight kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
+The nine kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
 
 ``commit_stall``        a running node has pending pool work but its ledger
                         has not grown for ``stall_window`` sim-seconds
@@ -35,11 +35,17 @@ The eight kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
                         least ``dedup_min_offered`` offered requests were
                         duplicates — a retry storm landing on the dedup
                         cache
+``engine_degraded``     the node's supervised verify engine is serving
+                        below its configured ladder rung (a fault-classed
+                        breaker opened — models/supervisor.py); clears when
+                        the supervisor re-promotes to rung 0
 
 The two ingress detectors read OPTIONAL health fields
 (``ingress_offered`` / ``ingress_rate_limited`` / ``ingress_dedup_hits``,
-fed by ingress/driver.py); cluster samples never carry them, so existing
-fixed-seed anomaly streams are untouched.
+fed by ingress/driver.py), and ``engine_degraded`` reads the optional
+``engine_degraded`` / ``engine_rung`` fields (fed only when a node carries
+an ``engine_supervisor``); samples without them, so every pre-existing
+fixed-seed anomaly stream, are untouched.
 """
 
 from __future__ import annotations
@@ -57,6 +63,7 @@ ANOMALY_KINDS = (
     "membership_churn",
     "admission_overload",
     "dedup_storm",
+    "engine_degraded",
 )
 
 
@@ -284,6 +291,19 @@ class DetectorBank:
                     fired, "dedup_storm", nid, t, storming,
                     f"dedup absorbed {d_dup}/{d_off} offered requests since "
                     "the last sample",
+                )
+
+            # --- engine degraded ---------------------------------------
+            degraded = h.get("engine_degraded")
+            if degraded is None:
+                # No supervised engine on this node: discard the latch so
+                # pre-supervision health streams stay byte-identical.
+                self._active.discard(("engine_degraded", nid))
+            else:
+                self._edge(
+                    fired, "engine_degraded", nid, t, bool(degraded),
+                    f"supervised verify engine serving at rung "
+                    f"{h.get('engine_rung', -1)} (below configured)",
                 )
 
             # --- verify-launch-rate collapse ---------------------------

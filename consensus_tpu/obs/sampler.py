@@ -196,6 +196,12 @@ class ClusterSampler:
             pool = getattr(cons, "pool", None)
             if pool is not None:
                 h["pool"] = int(pool.count)
+        # Optional supervision surface: only nodes carrying a supervised
+        # engine report it, so pre-supervision samples stay byte-identical.
+        sup = getattr(node, "engine_supervisor", None)
+        if sup is not None:
+            h["engine_degraded"] = bool(sup.degraded)
+            h["engine_rung"] = int(sup.rung)
         return h
 
     # --- reads -------------------------------------------------------------

@@ -154,6 +154,14 @@ class ShardedEd25519Verifier(Ed25519BatchVerifier):
         self._fn = sharded_verify_fn(self.mesh)
         self._n_shards = self.mesh.devices.size
 
+    @property
+    def shard_count(self) -> int:
+        """Devices this engine spreads a batch across.  The engine
+        supervisor's degrade ladder labels mesh rungs with it (an
+        ``N-shard`` rung degrading to a ``1-shard`` rung reads as exactly
+        that in logs/traces rather than two identical class names)."""
+        return self._n_shards
+
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         n = len(messages)
         if not (n == len(signatures) == len(public_keys)):
@@ -236,6 +244,11 @@ class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):
         self.mesh = mesh if mesh is not None else make_mesh()
         self._fn = sharded_p256_verify_fn(self.mesh)
         self._n_shards = self.mesh.devices.size
+
+    @property
+    def shard_count(self) -> int:
+        """Devices this engine spreads a batch across (ladder labeling)."""
+        return self._n_shards
 
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         from consensus_tpu.models.ecdsa_p256 import pad_prepared, to_kernel_layout
@@ -326,6 +339,11 @@ class ShardedEd25519RandomizedVerifier(Ed25519RandomizedBatchVerifier):
         self.mesh = mesh if mesh is not None else make_mesh()
         self._fn = sharded_batch_verify_fn(self.mesh)
         self._n_shards = self.mesh.devices.size
+
+    @property
+    def shard_count(self) -> int:
+        """Devices this engine spreads a batch across (ladder labeling)."""
+        return self._n_shards
 
     def _aggregate_device(self, idx, signatures, public_keys, scalars, zs):
         from consensus_tpu.models.ed25519 import (

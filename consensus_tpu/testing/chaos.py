@@ -62,6 +62,23 @@ Adversary vocabulary (``ChaosAction.kind``):
                                 safety).  ``generate(wan=None)`` draws a
                                 byte-identical schedule to before the
                                 vocabulary existed.
+``device_fault``                device-fault vocabulary
+                                (``generate(device_faults=True)`` only): arm
+                                the shared verify engine's launch-fault
+                                injector so its Kth next launch hangs
+                                (:class:`~consensus_tpu.models.supervisor.LaunchTimeout`),
+                                raises (an injected XLA launch failure), or
+                                flips its verdict bits.  A schedule carrying
+                                device-fault actions makes the engine wrap
+                                the shared crypto engine in a
+                                :class:`FaultInjectingEngine` under an
+                                :class:`~consensus_tpu.models.supervisor.EngineSupervisor`
+                                (host-twin cross-check every launch), so
+                                every injected fault is masked: ledgers and
+                                event logs stay byte-identical to the
+                                fault-free run.  ``generate(device_faults=
+                                False)`` consumes no extra RNG, so pinned
+                                schedules replay byte-identically.
 
 Everything runs on the SimScheduler's virtual clock — no wall-clock reads
 anywhere (scripts/check_no_wallclock.py lints this module too).
@@ -91,6 +108,15 @@ CHURN_KINDS = ("add_node", "remove_node")
 #: The WAN vocabulary: region-shaped topology actions, only drawn when a
 #: schedule names a geography profile.
 WAN_KINDS = ("region_partition", "leader_shift")
+
+#: The device-fault vocabulary: launch-level faults against the shared
+#: verify engine (not a node or a link), only drawn when a schedule opts in.
+DEVICE_FAULT_KINDS = ("device_fault",)
+
+#: The three injectable launch-fault classes, matching the supervisor's
+#: fault taxonomy: ``hang`` -> LaunchTimeout, ``raise`` -> launch raise,
+#: ``flip`` -> verdict corruption (caught by the host cross-check).
+DEVICE_FAULT_CLASSES = ("hang", "raise", "flip")
 
 #: Geography bank: per-profile region names, intra-region link latency
 #: ``(base, jitter)`` in sim-seconds, and the inter-region latency matrix
@@ -210,6 +236,10 @@ class ChaosSchedule:
     #: WAN geography profile name (a :data:`WAN_PROFILES` key) or None.
     #: Carried on the schedule so shrunk subsets keep their geography.
     wan: Optional[str] = None
+    #: True when the schedule was drawn with the device-fault vocabulary.
+    #: Carried so shrunk subsets keep arming the launch-fault injector even
+    #: after every ``device_fault`` action was deleted.
+    device_faults: bool = False
 
     @classmethod
     def generate(
@@ -222,6 +252,7 @@ class ChaosSchedule:
         start: float = 30.0,
         churn: bool = False,
         wan: Optional[str] = None,
+        device_faults: bool = False,
     ) -> "ChaosSchedule":
         """Derive a feasible schedule from ``seed``: action times are
         cumulative uniform(5, 40) gaps from ``start``, kinds are weighted
@@ -237,7 +268,13 @@ class ChaosSchedule:
         ``wan=<profile>`` (a :data:`WAN_PROFILES` key) pins the geography
         and adds ``region_partition`` / ``leader_shift`` to the vocabulary;
         ``wan=None`` consumes no extra RNG, so pre-WAN schedules replay
-        byte-identically."""
+        byte-identically.
+
+        ``device_faults=True`` adds ``device_fault`` to the vocabulary:
+        launch-level hang/raise/verdict-flip faults against the shared
+        verify engine, masked at run time by the engine supervisor;
+        ``device_faults=False`` consumes no extra RNG, so pre-device-fault
+        schedules replay byte-identically."""
         if wan is not None and wan not in WAN_PROFILES:
             raise ValueError(
                 f"unknown WAN profile {wan!r}; "
@@ -256,6 +293,9 @@ class ChaosSchedule:
         if wan is not None:
             kinds += list(WAN_KINDS)
             weights += [1.5, 1.0]
+        if device_faults:
+            kinds += list(DEVICE_FAULT_KINDS)
+            weights += [1.5]
         members = set(ids)
         next_id = n + 1
         t = start
@@ -356,6 +396,15 @@ class ChaosSchedule:
                     args={"region": region,
                           "factor": rng.choice([2.0, 4.0])},
                 ))
+            elif kind == "device_fault":
+                # ``launch`` is RELATIVE: the Kth verify launch after the
+                # action applies faults, so the action stays meaningful in
+                # shrunk subsets regardless of how many launches preceded it.
+                actions.append(ChaosAction(
+                    at=t, kind="device_fault",
+                    args={"fault": rng.choice(DEVICE_FAULT_CLASSES),
+                          "launch": rng.randrange(1, 4)},
+                ))
             else:  # arm_fault: the armed replica dies at the seam firing
                 node = rng.choice([i for i in ids if i not in down])
                 down.add(node)
@@ -366,7 +415,8 @@ class ChaosSchedule:
                           "hit": rng.randrange(1, 4)},
                 ))
         return cls(seed=seed, n=n, durability_window=durability_window,
-                   actions=tuple(actions), wan=wan)
+                   actions=tuple(actions), wan=wan,
+                   device_faults=device_faults)
 
 
 @dataclasses.dataclass
@@ -389,6 +439,76 @@ class ChaosResult:
     final_health: dict = dataclasses.field(default_factory=dict)
     #: Flight-recorder bundle path, when a recorder was armed AND triggered.
     flightrec_path: Optional[str] = None
+
+
+class FaultInjectingEngine:
+    """Deterministic launch-fault wrapper around a verify engine.
+
+    Counts ``verify_batch`` launches and, when an armed index comes up,
+    models one of the three supervisor fault classes:
+
+    * ``hang``  — raises :class:`~consensus_tpu.models.supervisor.LaunchTimeout`
+      (a real thread-hang would wedge the deterministic sim; the timeout
+      exception IS how the production watchdog surfaces one).
+    * ``raise`` — raises ``RuntimeError`` (an XLA launch failure / device
+      loss as the runtime reports it).
+    * ``flip``  — lets the launch complete, then inverts every verdict bit
+      (silent wrong answers, catchable only by the host cross-check).
+
+    ``verify_host`` passes through UNINJECTED — the host twin is ground
+    truth, so a supervisor wrapping this injector masks every fault.  All
+    other attributes forward to the wrapped engine.
+    """
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        #: Cumulative ``verify_batch`` calls (faulted launches count too).
+        self.launches = 0
+        #: Faults actually fired, in order: ``(launch index, fault kind)``.
+        self.fired: list[tuple[int, str]] = []
+        self._armed: dict[int, str] = {}
+
+    def arm(self, launch_offset: int, fault: str) -> None:
+        """Arm ``fault`` on the ``launch_offset``-th launch from now."""
+        if fault not in DEVICE_FAULT_CLASSES:
+            raise ValueError(
+                f"unknown device fault {fault!r}; "
+                f"choose from {DEVICE_FAULT_CLASSES}"
+            )
+        self._armed[self.launches + max(1, int(launch_offset))] = fault
+
+    @property
+    def pending(self) -> int:
+        """Armed faults that have not fired yet."""
+        return len(self._armed)
+
+    def verify_batch(self, *args, **kwargs):
+        from consensus_tpu.models.supervisor import LaunchTimeout
+
+        self.launches += 1
+        fault = self._armed.pop(self.launches, None)
+        if fault is not None:
+            self.fired.append((self.launches, fault))
+        if fault == "hang":
+            raise LaunchTimeout(
+                f"injected device hang at launch {self.launches}"
+            )
+        if fault == "raise":
+            raise RuntimeError(
+                f"injected XLA launch failure at launch {self.launches}"
+            )
+        out = self.engine.verify_batch(*args, **kwargs)
+        if fault == "flip":
+            import numpy as np
+
+            return np.logical_not(np.asarray(out))
+        return out
+
+    def verify_host(self, *args, **kwargs):
+        return self.engine.verify_host(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
 
 
 class ChaosEngine:
@@ -422,6 +542,7 @@ class ChaosEngine:
         engine_factory=None,
         obs=None,
         flight_dir: Optional[str] = None,
+        device_faults: tuple = (),
     ) -> None:
         """``crypto`` arms REAL Ed25519 on every replica signature path:
         ``"ed25519"`` uses the strict batch engine, ``"ed25519-batch"`` the
@@ -436,7 +557,23 @@ class ChaosEngine:
         engine construction — a zero-arg callable returning any object with
         the ``verify_batch`` contract.  The mesh parity gates use it to run
         the SAME schedule through sharded engines and assert byte-identical
-        ledgers/event logs against the single-device run."""
+        ledgers/event logs against the single-device run.
+
+        ``device_faults`` arms the launch-fault injector directly from the
+        constructor: a tuple of ``(launch_offset, fault)`` pairs (fault in
+        :data:`DEVICE_FAULT_CLASSES`), each firing on the given launch
+        counted from run start.  Arming is SILENT — no schedule action, no
+        event-log line, no RNG draw — so a run with constructor faults must
+        stay byte-identical to the clean run (the supervisor masks every
+        fault); the device-fault parity matrix is built on exactly that.
+        Either form of device faults (constructor pairs or ``device_fault``
+        schedule actions) implies a crypto run: ``crypto`` defaults up to
+        ``"ed25519"`` when unset."""
+        wants_faults = bool(device_faults) or any(
+            a.kind in DEVICE_FAULT_KINDS for a in schedule.actions
+        )
+        if wants_faults and crypto is None:
+            crypto = "ed25519"
         if crypto not in (None, "ed25519", "ed25519-batch", "ed25519-halfagg"):
             raise ValueError(f"unknown chaos crypto mode {crypto!r}")
         if engine_factory is not None and crypto is None:
@@ -484,6 +621,16 @@ class ChaosEngine:
         #: Active leader_shift ``(region, factor)`` or None — heal clears
         #: it along with every other topology knob.
         self._wan_shift: Optional[tuple] = None
+        #: Constructor-armed ``(launch_offset, fault)`` pairs, applied as
+        #: soon as the injector exists (before the cluster starts).
+        self.device_faults = tuple(device_faults)
+        self._wants_faults = wants_faults
+        #: The launch-fault injector, its supervisor, and the supervisor's
+        #: pinned-metrics bundle (``engine_degrade_total{reason}`` etc.) —
+        #: built by ``_install_crypto`` only on device-fault runs.
+        self.fault_injector: Optional[FaultInjectingEngine] = None
+        self.supervisor = None
+        self.engine_metrics = None
 
     # --- bookkeeping --------------------------------------------------------
 
@@ -636,6 +783,14 @@ class ChaosEngine:
             if self.recorder is not None:
                 self.recorder.watch_plan(plan)
             return True
+        if kind == "device_fault":
+            # Targets the SHARED verify engine, not a member — feasible
+            # whenever the injector exists (i.e. any crypto device-fault
+            # run; shrunk subsets keep it via schedule.device_faults).
+            if self.fault_injector is None:
+                return False
+            self.fault_injector.arm(args["launch"], args["fault"])
+            return True
         raise ValueError(f"unknown chaos action kind {kind!r}")
 
     def _order_reconfig(self, target_nodes) -> bool:
@@ -746,6 +901,29 @@ class ChaosEngine:
             )
         else:
             engine = Ed25519BatchVerifier(min_device_batch=10**9)
+        if self._wants_faults:
+            # Device-fault arm: injector under a supervisor whose host twin
+            # (the injector's UNINJECTED verify_host) is ground truth.
+            # crosscheck_interval=1 cross-checks EVERY launch, so verdict
+            # flips are caught on the launch they corrupt and never reach a
+            # quorum decision — that is what keeps faulted runs
+            # byte-identical to clean ones.  The supervisor's clock is the
+            # sim scheduler, so breaker backoff/re-probe run on sim time.
+            from consensus_tpu.metrics import InMemoryProvider, Metrics
+            from consensus_tpu.models.supervisor import EngineSupervisor
+
+            self.fault_injector = FaultInjectingEngine(engine)
+            for launch, fault in self.device_faults:
+                self.fault_injector.arm(launch, fault)
+            self.engine_metrics = Metrics(InMemoryProvider())
+            self.supervisor = EngineSupervisor(
+                [self.fault_injector],
+                clock=self.cluster.scheduler.now,
+                crosscheck_interval=1,
+                metrics=self.engine_metrics,
+                name="chaos-engine",
+            )
+            engine = self.supervisor
         signers = {
             nid: Ed25519Signer(
                 nid,
@@ -761,6 +939,10 @@ class ChaosEngine:
                 nid, self.cluster, signers[nid],
                 SigOnlyVerifier(keys, engine=engine),
             )
+            if self.supervisor is not None:
+                # Obs surface: the sampler reads node.engine_supervisor to
+                # export engine_degraded / engine_rung health fields.
+                node.engine_supervisor = self.supervisor
             if self.crypto == "ed25519-halfagg":
                 self._arm_halfagg_byz(nid, node.app)
 
@@ -1034,6 +1216,7 @@ def format_repro(result: ChaosResult) -> str:
         f"    n={s.n!r},",
         f"    durability_window={s.durability_window!r},",
         f"    wan={s.wan!r},",
+        f"    device_faults={s.device_faults!r},",
         "    actions=(",
     ]
     for a in s.actions:
@@ -1055,6 +1238,9 @@ __all__ = [
     "ChaosResult",
     "ChaosSchedule",
     "DEFAULT_TWEAKS",
+    "DEVICE_FAULT_CLASSES",
+    "DEVICE_FAULT_KINDS",
+    "FaultInjectingEngine",
     "WAN_KINDS",
     "WAN_PROFILES",
     "format_repro",

@@ -30,6 +30,16 @@ Without it, schedules are byte-identical to pre-WAN sweeps.
 
     python scripts/chaos_sweep.py --start 0 --count 50 --wan 3region
 
+``--device-faults`` adds the device-fault vocabulary to every schedule:
+``device_fault`` actions arm the shared verify engine's launch-fault
+injector (hang / raise / verdict-flip), the run is promoted to real
+Ed25519 crypto, and the engine supervisor must mask every fault — a seed
+fails exactly when an invariant is violated, i.e. when a fault leaked
+past the supervisor.  Without it, schedules are byte-identical to
+pre-device-fault sweeps.
+
+    python scripts/chaos_sweep.py --start 0 --count 50 --device-faults
+
 Every seed runs with the observability plane sampling (read-only: ledgers
 and verdicts are identical to an unsampled run) and emits one per-seed JSON
 line with its anomaly-detector counts and the final health snapshot of
@@ -72,27 +82,32 @@ def run_sweep(args) -> int:
         schedule = ChaosSchedule.generate(
             seed, n=args.nodes, steps=args.steps,
             durability_window=args.window, churn=args.churn,
-            wan=args.wan,
+            wan=args.wan, device_faults=args.device_faults,
         )
         # cert_mode="half-agg" needs an aggregation-capable verifier, so it
         # implies the real-crypto harness; "full" keeps the seed-identical
-        # trivial-crypto sweep.
+        # trivial-crypto sweep.  (A device-fault schedule promotes itself
+        # to "ed25519" inside the engine when crypto is unset.)
         crypto = "ed25519-halfagg" if args.cert_mode == "half-agg" else None
-        result = ChaosEngine(schedule, obs=obs, crypto=crypto).run()
+        engine = ChaosEngine(schedule, obs=obs, crypto=crypto)
+        result = engine.run()
         counts: dict[str, int] = {}
         for a in result.anomalies:
             counts[a.kind] = counts.get(a.kind, 0) + 1
             anomaly_totals[a.kind] = anomaly_totals.get(a.kind, 0) + 1
-        print(json.dumps(
-            {
-                "seed": seed,
-                "ok": result.ok,
-                "cert_mode": args.cert_mode,
-                "anomalies": dict(sorted(counts.items())),
-                "health": result.final_health,
-            },
-            sort_keys=True,
-        ))
+        record = {
+            "seed": seed,
+            "ok": result.ok,
+            "cert_mode": args.cert_mode,
+            "anomalies": dict(sorted(counts.items())),
+            "health": result.final_health,
+        }
+        if engine.fault_injector is not None:
+            record["device_faults_fired"] = [
+                {"launch": launch, "fault": fault}
+                for launch, fault in engine.fault_injector.fired
+            ]
+        print(json.dumps(record, sort_keys=True))
         if result.ok:
             if args.verbose:
                 height = max(len(d) for d in result.ledgers.values())
@@ -126,6 +141,7 @@ def run_sweep(args) -> int:
             "window": args.window,
             "churn": args.churn,
             "wan": args.wan,
+            "device_faults": args.device_faults,
             "cert_mode": args.cert_mode,
         },
     }
@@ -155,6 +171,12 @@ def main() -> int:
                     help="pin a WAN geography profile: per-link latency "
                          "distributions plus region_partition / "
                          "leader_shift in the vocabulary")
+    ap.add_argument("--device-faults", action="store_true",
+                    help="add device_fault actions (launch hang / raise / "
+                         "verdict-flip against the shared verify engine) "
+                         "to each schedule's vocabulary; implies real "
+                         "Ed25519 crypto and an engine supervisor that "
+                         "must mask every injected fault")
     ap.add_argument("--cert-mode", choices=("full", "half-agg"),
                     default="full",
                     help='quorum-cert format: "half-agg" runs every seed '

@@ -638,6 +638,75 @@ class TestWedgedDeviceEscapeHatch:
         assert fake.device_calls > before  # back on the device path
         v.close()
 
+    def test_flush_error_reaching_a_waiter_is_retried_on_host_not_raised(self):
+        """Regression: when the device flush fails AND the flusher's own
+        host attempt hits a transient, the error lands on the waiter —
+        which used to raise it out of ``verify_batch``.  With a host twin
+        available that is a degrade, not a decision-killer: the waiter
+        retries host-side on its own thread and the call completes."""
+        import numpy as np
+
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        class _DoubleFault:
+            def __init__(self):
+                self.host_calls = 0
+
+            def verify_batch(self, msgs, sigs, keys):
+                raise RuntimeError("device fell over")
+
+            def verify_host(self, msgs, sigs, keys):
+                self.host_calls += 1
+                if self.host_calls == 1:
+                    raise RuntimeError("host transient")
+                return np.array([s == b"good" for s in sigs], dtype=bool)
+
+        fake = _DoubleFault()
+        v = ThreadCoalescingVerifier(fake, window=0.005, wait_timeout=5.0)
+        out = v.verify_batch([b"m"] * 2, [b"good", b"bad"], [b"k"] * 2)
+        assert list(out) == [True, False]
+        assert fake.host_calls == 2  # flusher's failed try, waiter's retry
+        assert v.device_suspect
+        v.close()
+
+    def test_coalescers_share_suspect_state_per_engine(self):
+        """Two coalescers over the SAME engine share one EngineHealth entry
+        (the process-wide registry): a wedge seen by one routes the other
+        host-side immediately, without its own wait_timeout stall."""
+        from consensus_tpu.models import ThreadCoalescingVerifier
+
+        fake = self._Hung()
+        a = ThreadCoalescingVerifier(fake, window=0.005, wait_timeout=0.15)
+        b = ThreadCoalescingVerifier(fake, window=0.005, wait_timeout=60.0)
+        assert a.health is b.health
+        out = a.verify_batch([b"m"], [b"good"], [b"k"])  # wedges, abandons
+        assert list(out) == [True]
+        assert a.device_suspect and b.device_suspect
+        # b answers from host instantly — no 60s flush wait.
+        import time
+
+        start = time.monotonic()
+        assert list(b.verify_batch([b"m"], [b"bad"], [b"k"])) == [False]
+        assert time.monotonic() - start < 5.0
+        fake.never.set()  # unwedge so close() doesn't ride out wait_timeout
+        a.close()
+        b.close()
+
+    def test_probe_pacing_uses_injected_scheduler_clock(self):
+        """Suspect re-probes ride the protocol clock when the embedder
+        hands one over; only the schedulerless sidecar path reads the
+        audited wall clock."""
+        from consensus_tpu.models import ThreadCoalescingVerifier
+        from consensus_tpu.runtime.scheduler import SimScheduler
+
+        sched = SimScheduler()
+        fake = self._Hung()
+        v = ThreadCoalescingVerifier(
+            fake, window=0.005, wait_timeout=0.15, scheduler=sched
+        )
+        assert v._probe_clock == sched.now
+        v.close()
+
 
 class TestSharding:
     def test_sharded_matches_single_device(self):

@@ -318,6 +318,118 @@ def test_real_fleet_reroutes_tenant_admission_reject():
         srv_b.stop()
 
 
+class _CountingEngine(_GoodEngine):
+    def __init__(self):
+        self.host_calls = 0
+
+    def verify_host(self, msgs, sigs, keys):
+        self.host_calls += 1
+        return self.verify_batch(msgs, sigs, keys)
+
+
+def test_reroute_exhaustion_falls_back_locally_exactly_once():
+    """Every ring candidate refuses the batch (all queues too small): the
+    client walks the whole ring, then falls back to its LOCAL host engine
+    exactly once — no reroute is booked (nothing was handed off) and no
+    suspect flag is raised (admission pressure is not a wedged device)."""
+    from consensus_tpu.net.sidecar import (
+        SidecarVerifierClient,
+        VerifySidecarServer,
+    )
+
+    tenants = {"alpha": b"alpha-secret"}
+    metrics = Metrics(InMemoryProvider())
+    srv_a = VerifySidecarServer(
+        ("127.0.0.1", 0), _GoodEngine(), tenants=tenants,
+        wave_window=0.02, tenant_queue_limit=1,
+    )
+    srv_b = VerifySidecarServer(
+        ("127.0.0.1", 0), _GoodEngine(), tenants=tenants,
+        wave_window=0.02, tenant_queue_limit=1,
+    )
+    srv_a.start()
+    srv_b.start()
+    local = _CountingEngine()
+    fleet = SidecarFleet(
+        {"srv-a": srv_a.address, "srv-b": srv_b.address},
+        client_factory=lambda addr: SidecarVerifierClient(
+            addr, auth_secret=tenants["alpha"], tenant="alpha",
+        ),
+        metrics=metrics.ingress,
+    )
+    client = SidecarVerifierClient(
+        srv_a.address, auth_secret=tenants["alpha"], tenant="alpha",
+        fleet=fleet, fleet_id="srv-a", local_engine=local,
+    )
+    try:
+        out = client.verify_batch([b"m"] * 20, [b"good"] * 20, [b"k"] * 20)
+        assert out.all() and len(out) == 20
+        assert local.host_calls == 1
+        assert fleet.reroutes == []
+        dump = metrics.provider.dump()
+        assert dump[INGRESS_REROUTE_KEY]["value"] == 0
+        assert not client._suspect, "admission reject must not mark suspect"
+    finally:
+        client.close()
+        fleet.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_degraded_server_surfaces_on_status_byte_and_demotes_in_ring():
+    """A server whose supervised engine is below its top rung answers with
+    status 3 (same verdict body — the host twin is still ground truth);
+    the placement-aware client records the observation on the fleet, which
+    moves that server to the BACK of every candidate walk until a status-0
+    answer clears it."""
+    from consensus_tpu.net.sidecar import (
+        SidecarVerifierClient,
+        VerifySidecarServer,
+    )
+
+    class _DegradedEngine(_GoodEngine):
+        degraded = True
+
+    engine = _DegradedEngine()
+    tenants = {"alpha": b"alpha-secret"}
+    srv_a = VerifySidecarServer(
+        ("127.0.0.1", 0), engine, tenants=tenants, wave_window=0.02,
+    )
+    srv_b = VerifySidecarServer(
+        ("127.0.0.1", 0), _GoodEngine(), tenants=tenants, wave_window=0.02,
+    )
+    srv_a.start()
+    srv_b.start()
+    fleet = SidecarFleet(
+        {"srv-a": srv_a.address, "srv-b": srv_b.address},
+        client_factory=lambda addr: SidecarVerifierClient(
+            addr, auth_secret=tenants["alpha"], tenant="alpha",
+        ),
+    )
+    client = SidecarVerifierClient(
+        srv_a.address, auth_secret=tenants["alpha"], tenant="alpha",
+        fleet=fleet, fleet_id="srv-a",
+    )
+    try:
+        out = client.verify_batch([b"m"] * 4, [b"good"] * 4, [b"k"] * 4)
+        assert out.all() and len(out) == 4  # verdicts unchanged by status 3
+        assert fleet.is_degraded("srv-a")
+        for tenant in ("alpha", "beta", "gamma"):
+            assert fleet.candidates(tenant)[-1] == "srv-a"
+        # Recovery: the engine re-promotes, the next answer is status 0,
+        # and the ring restores pure rendezvous order.
+        engine.degraded = False
+        assert client.verify_batch([b"m"], [b"good"], [b"k"]).all()
+        assert not fleet.is_degraded("srv-a")
+        for tenant in ("alpha", "beta", "gamma"):
+            assert fleet.candidates(tenant) == fleet.ring.candidates(tenant)
+    finally:
+        client.close()
+        fleet.close()
+        srv_a.stop()
+        srv_b.stop()
+
+
 # --- WAN scenario bank ------------------------------------------------------
 
 

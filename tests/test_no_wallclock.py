@@ -129,7 +129,8 @@ def test_lint_covers_models_aggregate():
     presence, independently of the package-wide walk."""
     models_dir = os.path.join(_REPO, "consensus_tpu", "models")
     present = {f for f in os.listdir(models_dir) if f.endswith(".py")}
-    assert {"aggregate.py", "ed25519.py", "verifier.py"} <= present
+    assert {"aggregate.py", "ed25519.py",
+            "verifier.py", "supervisor.py"} <= present
     proc = subprocess.run(
         [sys.executable, _SCRIPT, models_dir],
         capture_output=True,

@@ -201,8 +201,9 @@ def test_leader_churn_schedule_fires_storm_and_flap_detectors():
     partition_kinds = {"commit_stall", "sync_lag", "verify_collapse"}
     churn_kinds = {"membership_churn"}
     ingress_kinds = {"admission_overload", "dedup_storm"}
-    assert (partition_kinds | churn_kinds | ingress_kinds | set(counts)
-            >= set(ANOMALY_KINDS))
+    engine_kinds = {"engine_degraded"}  # tests/test_supervisor.py end-to-end
+    assert (partition_kinds | churn_kinds | ingress_kinds | engine_kinds
+            | set(counts) >= set(ANOMALY_KINDS))
 
 
 def test_detector_firings_are_deterministic():
