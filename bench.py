@@ -680,6 +680,146 @@ def bench_ingress_main() -> int:
     return 0
 
 
+#: Fixed workload for the host-side WAL family — sized so the whole log
+#: stays in one 64 MiB segment and a run finishes in a few seconds even on
+#: a slow disk (the appends fsync for real).
+WAL_ENTRIES = 2000
+WAL_ENTRY_SIZE = 256
+#: Small segments so the log rolls: quarantine (3b) only exercises its
+#: real path when the corruption sits in a NON-tail segment (tail tears
+#: are repair()'s job, not quarantine's).
+WAL_SEGMENT_BYTES = 64 * 1024
+WAL_GROUP_BURST = 16
+WAL_GROUP_WINDOW = 0.005
+
+
+def bench_wal() -> dict:
+    """``wal`` family: host-side durable-log throughput and recovery cost.
+
+    Times the three paths a replica actually pays for: (1) per-append
+    fsync throughput (persist-before-broadcast floor without group
+    commit), (2) the group-commit coalescing ratio under a sim-clocked
+    window (records per data fsync — trace-determined, so a drift means
+    the batching changed, not the machine), and (3) cold recovery: boot
+    scan of the intact log vs the quarantine path after a non-tail
+    corruption (the amnesia-recovery cost the scrub/quarantine subsystem
+    introduces).  No device, no sockets — this family always runs live.
+    """
+    import shutil
+    import tempfile
+
+    from consensus_tpu.runtime.scheduler import SimScheduler
+    from consensus_tpu.wal import WriteAheadLog, initialize_and_read_all
+
+    entries = [bytes([i % 256]) * WAL_ENTRY_SIZE for i in range(WAL_ENTRIES)]
+    root = tempfile.mkdtemp(prefix="bench-wal-")
+    try:
+        # (1) per-append fsync throughput.
+        sync_dir = os.path.join(root, "sync")
+        wal = WriteAheadLog.create(sync_dir, segment_max_bytes=WAL_SEGMENT_BYTES)
+        t0 = time.perf_counter()
+        for e in entries:
+            wal.append(e)
+        sync_elapsed = time.perf_counter() - t0
+        sync_fsyncs = wal.fsync_count
+        wal.close()
+
+        # (2) group-commit coalescing: bursts land in the window, one
+        # data fsync drains each burst when the sim clock passes it.
+        sched = SimScheduler()
+        group_dir = os.path.join(root, "group")
+        gwal = WriteAheadLog.create(
+            group_dir, scheduler=sched, group_commit_window=WAL_GROUP_WINDOW
+        )
+        t0 = time.perf_counter()
+        for i in range(0, WAL_ENTRIES, WAL_GROUP_BURST):
+            for e in entries[i:i + WAL_GROUP_BURST]:
+                gwal.append(e)
+            sched.advance(WAL_GROUP_WINDOW * 2)
+        group_elapsed = time.perf_counter() - t0
+        group_ratio = WAL_ENTRIES / max(1, gwal.fsync_count)
+        gwal.close()
+
+        # (3a) cold recovery, intact log: full boot scan + CRC walk.
+        t0 = time.perf_counter()
+        wal2, initial = initialize_and_read_all(
+            sync_dir, segment_max_bytes=WAL_SEGMENT_BYTES
+        )
+        recovery_intact_s = time.perf_counter() - t0
+        assert len(initial) == WAL_ENTRIES
+        wal2.close()
+
+        # (3b) cold recovery, quarantine path: flip a payload byte in a
+        # MIDDLE segment (durable records damaged at rest — repair
+        # refuses) so boot must set the damaged suffix aside and come
+        # back up on the intact prefix (the amnesia case).
+        segs = sorted(n for n in os.listdir(sync_dir) if n.endswith(".wal"))
+        assert len(segs) >= 3, segs
+        seg = os.path.join(sync_dir, segs[len(segs) // 2])
+        with open(seg, "r+b") as fh:
+            fh.seek(20)  # first record's payload (past header + type/flag)
+            b = fh.read(1)
+            fh.seek(20)
+            fh.write(bytes([b[0] ^ 0x40]))
+        t0 = time.perf_counter()
+        wal3, recovered = initialize_and_read_all(
+            sync_dir, quarantine_corrupt=True,
+            segment_max_bytes=WAL_SEGMENT_BYTES,
+        )
+        recovery_quarantine_s = time.perf_counter() - t0
+        assert wal3.recovery is not None
+        assert 0 < len(recovered) < WAL_ENTRIES
+        wal3.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    rate = WAL_ENTRIES / sync_elapsed if sync_elapsed > 0 else 0.0
+    return {
+        "metric": "wal_append_throughput",
+        "value": round(rate, 1),
+        "unit": "appends/sec",
+        "entries": WAL_ENTRIES,
+        "entry_bytes": WAL_ENTRY_SIZE,
+        "sync_fsyncs": sync_fsyncs,
+        "group_commit_ratio": round(group_ratio, 2),
+        "group_elapsed_s": round(group_elapsed, 4),
+        "recovery_intact_ms": round(recovery_intact_s * 1e3, 2),
+        "recovery_quarantine_ms": round(recovery_quarantine_s * 1e3, 2),
+        "recovered_prefix": len(recovered),
+    }
+
+
+def bench_wal_main() -> int:
+    """The ``wal`` family entry point: live measurement with the same
+    structured-skip + last-good trail discipline as the other families (a
+    broken disk or tempdir must not turn the bench lane red)."""
+    metric = "wal_append_throughput"
+    try:
+        record = bench_wal()
+    except Exception as exc:  # noqa: BLE001 — any failure becomes a skip
+        last_good = _load_last_good(metric)
+        print(json.dumps({
+            "metric": metric,
+            "skipped": "wal-bench-error",
+            "detail": repr(exc),
+            "last_good": dict(last_good, stale=True) if last_good else None,
+        }))
+        return 0
+    _save_last_good(
+        metric, record["value"], record["group_commit_ratio"],
+        unit="appends/sec", hardware="host",
+    )
+    print(json.dumps(record))
+    print(
+        f"# wal append {record['value']:.0f}/s fsynced, group-commit "
+        f"{record['group_commit_ratio']:.1f} records/fsync, recovery "
+        f"{record['recovery_intact_ms']:.1f}ms intact / "
+        f"{record['recovery_quarantine_ms']:.1f}ms quarantine",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main() -> None:
     from __graft_entry__ import _enable_compile_cache
 
@@ -688,6 +828,9 @@ def main() -> None:
     if family == "ingress":
         # Host-side family: no device probe, no JAX import.
         sys.exit(bench_ingress_main())
+    if family == "wal":
+        # Host-side family: durable-log throughput + recovery cost.
+        sys.exit(bench_wal_main())
     metric = {
         "p256": "ecdsa_p256_verify_throughput",
         "cert_verify": "cert_verify_throughput",

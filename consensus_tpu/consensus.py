@@ -176,6 +176,13 @@ class Consensus:
         # Sequence i was delivered -> we expect proposal i+1 next.
         self._start_components(view, seq + 1, dec)
         self._readmit_abandoned()
+        if getattr(self.wal, "recovery", None) is not None:
+            # Boot quarantined a corrupt WAL suffix: votes this replica
+            # already sent may be gone from its durable state, so it joins
+            # as a NON-VOTING LEARNER and re-enters the voter set only once
+            # verified sync carries its checkpoint past the release bound
+            # (SAFETY.md §13).
+            self.controller.fence_as_learner(self.controller.latest_seq())
         self._running = True
 
     def _readmit_abandoned(self) -> None:
@@ -341,6 +348,28 @@ class Consensus:
         controller._proposer_builder = proposer_builder
 
         self._create_view_changer()
+        self._wire_storage_guard()
+
+    def _wire_storage_guard(self) -> None:
+        """Couple the durable-storage self-healing layer (wal/log.py) to the
+        controller: while the WAL refuses appends (ENOSPC, fsync retry cap)
+        the replica must not propose or vote — persist-before-send has
+        nothing durable to stand on — and it auto-resumes when the log
+        heals.  Only file-backed WALs carry ``degrade_hooks``; in-memory
+        test WALs skip the wiring entirely."""
+        hooks = getattr(self.wal, "degrade_hooks", None)
+        if hooks is None:
+            return
+        # A reconfiguration rebuilds the controller: drop the hook pointed
+        # at the retired instance before installing the new one.
+        prev = getattr(self, "_wal_degrade_hook", None)
+        if prev is not None and prev in hooks:
+            hooks.remove(prev)
+        hook = self.controller.set_wal_degraded
+        hooks.append(hook)
+        self._wal_degrade_hook = hook
+        if getattr(self.wal, "degraded", False):
+            self.controller.set_wal_degraded(True)
 
     def _create_view_changer(self) -> None:
         """Plug in the view changer (split out so the happy-path slice works

@@ -72,6 +72,15 @@ logger = logging.getLogger("consensus_tpu.controller")
 #: tests (see tests for the fixture that arms and disarms it).
 SENTINEL_STALE_MEMBERSHIP = False
 
+#: TEST-ONLY seeded bug: when True, a replica that quarantined a corrupt WAL
+#: suffix skips the learner fence entirely — it keeps voting from its
+#: amnesiac state before verified sync has carried it past the last intact
+#: record.  The learner-fence invariant (testing/invariants.py via the chaos
+#: engine's delivery hooks) must catch the resulting votes, because a vote
+#: the replica already persisted-and-sent from the quarantined suffix could
+#: be re-issued differently (SAFETY.md §13).  Never set outside tests.
+SENTINEL_EAGER_UNFENCE = False
+
 
 class ViewChangerPort(Protocol):
     """What the controller needs from the view changer (it is also the
@@ -155,6 +164,17 @@ class Controller:
         # queued commits for higher slots must NOT deliver — their certs
         # belong to the retired membership (SAFETY.md §8).
         self._reconfig_pending = False
+        # Storage fence: while _fence_height is set this replica is a
+        # NON-VOTING LEARNER — it quarantined a corrupt WAL suffix and may
+        # have forgotten votes it already sent, so it must not vote again
+        # until verified sync carries its checkpoint past _fence_release
+        # (SAFETY.md §13).  _wal_degraded suspends proposing/voting while
+        # the WAL refuses appends (persist-before-send has nothing durable
+        # to stand on) but needs no fence: nothing was forgotten.
+        self._fence_height: Optional[int] = None
+        self._fence_release: Optional[int] = None
+        self._fence_resync_timer = None
+        self._wal_degraded = False
 
     # ------------------------------------------------------------ identity
 
@@ -210,6 +230,8 @@ class Controller:
             "in_flight": v.in_flight_depth() if v is not None else 0,
             "syncing": self._sync_in_progress,
             "epoch": self.membership_epoch,
+            "fenced": self.fence_required(),
+            "wal_degraded": self._wal_degraded,
         }
 
     # ----------------------------------------------------------- lifecycle
@@ -245,6 +267,9 @@ class Controller:
         """Parity: reference controller.go:834-871 (Stop/StopWithPoolPause)."""
         self._stopped = True
         self._leader_token = False
+        if self._fence_resync_timer is not None:
+            self._fence_resync_timer.cancel()
+            self._fence_resync_timer = None
         self.batcher.close()
         if pool_pause_only:
             self.pool.stop_timers()
@@ -340,6 +365,16 @@ class Controller:
         if self._stopped:
             return
         if isinstance(msg, (PrePrepare, Prepare, Commit)):
+            if self._voting_suspended():
+                # Fenced learner / degraded WAL: drop 3-phase traffic (we
+                # must not vote), but still count leader traffic as a
+                # heartbeat so the monitor doesn't manufacture complaints
+                # about a leader that is in fact making progress.
+                if sender == self.leader_id():
+                    self.leader_monitor.inject_artificial_heartbeat(
+                        sender, HeartBeat(view=msg.view, seq=msg.seq)
+                    )
+                return
             if self.curr_view is not None:
                 self.curr_view.handle_message(sender, msg)
             if self.view_changer is not None:
@@ -349,6 +384,11 @@ class Controller:
                     sender, HeartBeat(view=msg.view, seq=msg.seq)
                 )
         elif isinstance(msg, (ViewChange, SignedViewData, NewView)):
+            if self._voting_suspended():
+                # View-change participation is also a vote (and carries our
+                # possibly-amnesiac state); the fenced replica re-learns
+                # view math from verified sync instead.
+                return
             if self.view_changer is not None:
                 self.view_changer.handle_message(sender, msg)
         elif isinstance(msg, (HeartBeat, HeartBeatResponse)):
@@ -415,15 +455,135 @@ class Controller:
     def complain(self, view: int, stop_view: bool) -> None:
         """FailureDetector seam.  Parity: consensus.go wires the view changer
         here (pkg/consensus/consensus.go:69-73)."""
+        if self._voting_suspended():
+            # A complaint is a vote to change views; a fenced learner (or a
+            # replica whose WAL refuses appends) must not cast it.
+            return
         if self.view_changer is not None:
             self.view_changer.start_view_change(view, stop_view)
+
+    # --------------------------------------- storage fence / degraded WAL
+
+    def fence_as_learner(self, intact_height: int) -> None:
+        """Suspend voting after WAL corruption was quarantined: this replica
+        may have forgotten votes it already sent from the quarantined
+        suffix, so re-voting those slots could equivocate.  It keeps
+        serving reads and state transfer, and resumes voting only once a
+        verified sync carries its checkpoint past a release bound above the
+        last intact record (SAFETY.md §13)."""
+        if self._fence_height is not None:
+            return  # already fenced; keep the original intact height
+        self._fence_height = max(0, int(intact_height))
+        self._fence_release = None
+        logger.warning(
+            "%d: fencing as non-voting learner (intact height %d)",
+            self.id, self._fence_height,
+        )
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "controller", "fence.enter", intact=self._fence_height
+            )
+        self._leader_token = False
+        self.batcher.close()
+        if not self._stopped:
+            self.sync()
+
+    def fence_required(self) -> bool:
+        """Ground truth for the invariant monitor: True whenever the fence
+        bookkeeping says this replica must not vote — deliberately
+        independent of the SENTINEL_EAGER_UNFENCE enforcement bypass, so a
+        seeded eager-unfence bug is observable from the outside."""
+        return self._fence_height is not None
+
+    def _fence_active(self) -> bool:
+        if SENTINEL_EAGER_UNFENCE:
+            return False
+        return self._fence_height is not None
+
+    def _voting_suspended(self) -> bool:
+        return self._wal_degraded or self._fence_active()
+
+    def set_wal_degraded(self, degraded: bool) -> None:
+        """WAL degrade hook (wal/log.py degrade_hooks): while the log
+        refuses appends, persist-before-send has nothing durable to stand
+        on, so stop proposing and voting; auto-resume when it heals."""
+        degraded = bool(degraded)
+        if degraded == self._wal_degraded:
+            return
+        self._wal_degraded = degraded
+        if degraded:
+            logger.warning(
+                "%d: WAL degraded; suspending proposing/voting", self.id
+            )
+            self._leader_token = False
+            return
+        logger.info("%d: WAL recovered; resuming consensus duties", self.id)
+        if not self._stopped and self.i_am_the_leader():
+            self._acquire_leader_token()
+
+    def _maybe_release_fence(self) -> None:
+        """Called whenever the checkpoint advances.  The first verified
+        sync after fencing pins the release bound: any vote this replica
+        sent from the quarantined suffix was persisted first
+        (persist-before-send), so its slot sits at most ``pipeline_depth``
+        above what the cluster had decided when we crashed — which is at
+        most the synced height.  Once the checkpoint passes that bound,
+        every slot we could have voted on is decided and certified by
+        others, and re-joining the voter set cannot equivocate."""
+        if self._fence_height is None:
+            return
+        latest = self.latest_seq()
+        if self._fence_release is None:
+            self._fence_release = (
+                max(latest, self._fence_height)
+                + max(1, self._config.pipeline_depth)
+            )
+            logger.info(
+                "%d: fence release bound set at seq %d (synced %d)",
+                self.id, self._fence_release, latest,
+            )
+        if latest >= self._fence_release:
+            logger.info(
+                "%d: fence released at seq %d; resuming voting",
+                self.id, latest,
+            )
+            if self._tracer.enabled:
+                self._tracer.instant(
+                    "controller", "fence.exit",
+                    seq=latest, release=self._fence_release,
+                )
+            self._fence_height = None
+            self._fence_release = None
+            if self._fence_resync_timer is not None:
+                self._fence_resync_timer.cancel()
+                self._fence_resync_timer = None
+            if (
+                not self._stopped
+                and self.i_am_the_leader()
+                and not self._voting_suspended()
+            ):
+                self._acquire_leader_token()
+            return
+        # Still short of the bound: keep pulling verified state.
+        if self._fence_resync_timer is None and not self._stopped:
+            self._fence_resync_timer = self._sched.call_later(
+                self._config.view_change_resend_interval,
+                self._fence_resync,
+                name="fence-resync",
+            )
+
+    def _fence_resync(self) -> None:
+        self._fence_resync_timer = None
+        if self._stopped or self._fence_height is None:
+            return
+        self.sync()
 
     # ------------------------------------------------------------ proposing
 
     def _acquire_leader_token(self) -> None:
         """Parity: reference controller.go:748-755 — but as a scheduled
         continuation instead of a channel token."""
-        if self._leader_token:
+        if self._leader_token or self._voting_suspended():
             return
         self._leader_token = True
         if not self._propose_pending:
@@ -552,6 +712,7 @@ class Controller:
             # Synced-past slots never hit the per-delivery removal path, so
             # their reservations would pin pooled requests forever.
             self.pool.release_reservations()
+            self._maybe_release_fence()
             return response.reconfig
         tracing = self._tracer.enabled
         if tracing:
@@ -574,6 +735,7 @@ class Controller:
         # undecided slot, and the persist-before-sign coupling check must
         # not match against an already-delivered entry.
         self._state.prune_decided(md.latest_sequence)
+        self._maybe_release_fence()
         return reconfig
 
     def deliver(self, proposal: Proposal, signatures: Sequence[Signature]) -> Reconfig:
@@ -761,6 +923,7 @@ class Controller:
         decisions: int,
         on_complete: Optional[Callable[[int, int, int], None]],
     ) -> None:
+        self._maybe_release_fence()
         self.maybe_prune_revoked_requests()
         if on_complete is not None:
             # start(sync_on_start=True) path: caller decides what to start.

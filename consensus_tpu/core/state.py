@@ -12,6 +12,7 @@ from typing import Callable, Optional, Sequence
 
 from consensus_tpu.api.deps import WriteAheadLog
 from consensus_tpu.core.view import Phase, View
+from consensus_tpu.wal.log import WALError
 from consensus_tpu.types import Proposal, Signature
 from consensus_tpu.wire import (
     Commit,
@@ -263,13 +264,25 @@ class PersistedState:
             ):
                 slot[1] = record
         self._last_written = record
-        self._wal.append(
-            encode_saved(record),
-            truncate_to=(
-                isinstance(record, ProposedRecord) if truncate is None else truncate
-            ),
-            on_durable=on_durable,
-        )
+        try:
+            self._wal.append(
+                encode_saved(record),
+                truncate_to=(
+                    isinstance(record, ProposedRecord) if truncate is None else truncate
+                ),
+                on_durable=on_durable,
+            )
+        except WALError as err:
+            if getattr(self._wal, "degraded", False):
+                # The append was refused by a degraded WAL (ENOSPC, fsync
+                # retry cap).  Swallow the failure WITHOUT firing
+                # ``on_durable``: the dependent send never happens
+                # (persist-before-send holds vacuously), and the degrade
+                # hook already suspended this replica's proposing/voting
+                # (core/controller.py::set_wal_degraded).
+                logger.warning("WAL append refused while degraded: %s", err)
+                return
+            raise
         if plan is not None:
             plan.crash(point + ".post")
 

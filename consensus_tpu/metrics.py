@@ -52,6 +52,8 @@ OBS_ANOMALY_MEMBERSHIP_CHURN_KEY = "obs_anomaly_membership_churn"
 OBS_ANOMALY_ADMISSION_OVERLOAD_KEY = "obs_anomaly_admission_overload"
 OBS_ANOMALY_DEDUP_STORM_KEY = "obs_anomaly_dedup_storm"
 OBS_ANOMALY_ENGINE_DEGRADED_KEY = "obs_anomaly_engine_degraded"
+OBS_ANOMALY_WAL_CORRUPTION_KEY = "obs_anomaly_wal_corruption"
+OBS_ANOMALY_WAL_STALL_KEY = "obs_anomaly_wal_stall"
 OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_COMMIT_STALL_KEY,
     OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
@@ -62,6 +64,31 @@ OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_ADMISSION_OVERLOAD_KEY,
     OBS_ANOMALY_DEDUP_STORM_KEY,
     OBS_ANOMALY_ENGINE_DEGRADED_KEY,
+    OBS_ANOMALY_WAL_CORRUPTION_KEY,
+    OBS_ANOMALY_WAL_STALL_KEY,
+)
+
+#: Pinned instrument names for durable-state self-healing (wal/scrub.py,
+#: wal/log.py's degrade path, testing/storage.py's injected faults).  Every
+#: storage-fault transition is triple-booked: one of these instruments, a
+#: ``wal.*`` trace instant, and the ``wal_corruption`` / ``wal_stall`` obs
+#: detectors.  The chaos matrix asserts EXACTLY ONE quarantine or degraded
+#: transition per injected fault, keyed on these names.
+WAL_FSYNC_RETRY_KEY = "wal_fsync_retry_total"
+WAL_SCRUB_RUNS_KEY = "wal_scrub_runs_total"
+WAL_SCRUB_RECORDS_KEY = "wal_scrub_records_total"
+WAL_SCRUB_CORRUPTIONS_KEY = "wal_scrub_corruptions_total"
+WAL_QUARANTINE_KEY = "wal_quarantine_total"
+WAL_DEGRADED_KEY = "wal_degraded"
+WAL_DEGRADED_TOTAL_KEY = "wal_degraded_total"
+WAL_STORAGE_KEYS = (
+    WAL_FSYNC_RETRY_KEY,
+    WAL_SCRUB_RUNS_KEY,
+    WAL_SCRUB_RECORDS_KEY,
+    WAL_SCRUB_CORRUPTIONS_KEY,
+    WAL_QUARANTINE_KEY,
+    WAL_DEGRADED_KEY,
+    WAL_DEGRADED_TOTAL_KEY,
 )
 
 #: Pinned instrument names for the membership-epoch subsystem
@@ -200,6 +227,28 @@ PINNED_METRIC_KEYS: dict[str, str] = {
     OBS_ANOMALY_ENGINE_DEGRADED_KEY:
         "detector firings: a supervised verify engine running below its "
         "configured rung",
+    OBS_ANOMALY_WAL_CORRUPTION_KEY:
+        "detector firings: a replica quarantined corrupt WAL state or is "
+        "fenced as a non-voting learner",
+    OBS_ANOMALY_WAL_STALL_KEY:
+        "detector firings: a replica's WAL stopped accepting appends "
+        "(degraded: ENOSPC or fsync-retry cap)",
+    WAL_FSYNC_RETRY_KEY:
+        "group-commit fsync attempts that failed and were re-armed",
+    WAL_SCRUB_RUNS_KEY:
+        "background scrub passes over the WAL segment inventory",
+    WAL_SCRUB_RECORDS_KEY:
+        "records re-walked (CRC re-verified) by the background scrubber",
+    WAL_SCRUB_CORRUPTIONS_KEY:
+        "corruptions detected by the scrubber or at open/restore time",
+    WAL_QUARANTINE_KEY:
+        "corrupt WAL suffixes renamed aside (never deleted) preserving the "
+        "intact prefix",
+    WAL_DEGRADED_KEY:
+        "whether the WAL is refusing appends (1 = degraded: ENOSPC or "
+        "fsync-retry cap; gauge)",
+    WAL_DEGRADED_TOTAL_KEY:
+        "transitions into wal_degraded (append path unsatisfiable)",
     INGRESS_OFFERED_KEY:
         "client requests offered to the ingress admission layer",
     INGRESS_ADMITTED_KEY:
@@ -460,7 +509,11 @@ class _Bundle:
 
 
 class MetricsWAL(_Bundle):
-    """Parity: reference pkg/wal/metrics.go:8-37 (1 instrument)."""
+    """Parity: reference pkg/wal/metrics.go:8-37 (1 instrument), plus the
+    self-healing instruments (consensus_tpu addition): fsync-retry
+    accounting, the background scrubber's pass/record/corruption counters,
+    quarantine bookkeeping, and the degraded-mode gauge + transition
+    counter.  The pinned names live in :data:`PINNED_METRIC_KEYS`."""
 
     def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
         ln = extend_label_names((), label_names)
@@ -468,6 +521,42 @@ class MetricsWAL(_Bundle):
             "wal_count_of_files", "Count of wal-files.", ln
         )
         self.count_of_files.add(0)  # reference Initialize()
+        self.fsync_retries = p.new_counter(
+            WAL_FSYNC_RETRY_KEY,
+            "Group-commit fsync attempts that failed and were re-armed.",
+            ln,
+        )
+        self.scrub_runs = p.new_counter(
+            WAL_SCRUB_RUNS_KEY,
+            "Background scrub passes over the WAL segment inventory.",
+            ln,
+        )
+        self.scrub_records = p.new_counter(
+            WAL_SCRUB_RECORDS_KEY,
+            "Records re-walked (CRC re-verified) by the scrubber.",
+            ln,
+        )
+        self.scrub_corruptions = p.new_counter(
+            WAL_SCRUB_CORRUPTIONS_KEY,
+            "Corruptions detected by the scrubber or at open/restore time.",
+            ln,
+        )
+        self.quarantines = p.new_counter(
+            WAL_QUARANTINE_KEY,
+            "Corrupt WAL suffixes renamed aside preserving the prefix.",
+            ln,
+        )
+        self.degraded = p.new_gauge(
+            WAL_DEGRADED_KEY,
+            "Whether the WAL is refusing appends (1 = degraded).",
+            ln,
+        )
+        self.degraded.add(0)
+        self.degraded_transitions = p.new_counter(
+            WAL_DEGRADED_TOTAL_KEY,
+            "Transitions into wal_degraded (append path unsatisfiable).",
+            ln,
+        )
 
 
 class MetricsRequestPool(_Bundle):
@@ -738,6 +827,16 @@ class MetricsObs(_Bundle):
             "configured rung).",
             ln,
         )
+        self.count_anomaly_wal_corruption = p.new_counter(
+            OBS_ANOMALY_WAL_CORRUPTION_KEY,
+            "WAL-corruption detector firings (quarantine or learner fence).",
+            ln,
+        )
+        self.count_anomaly_wal_stall = p.new_counter(
+            OBS_ANOMALY_WAL_STALL_KEY,
+            "WAL-stall detector firings (degraded: appends refused).",
+            ln,
+        )
 
     def anomaly_counter(self, kind: str) -> Counter:
         """The pinned counter for detector ``kind`` (its short name, e.g.
@@ -1001,7 +1100,17 @@ __all__ = [
     "OBS_ANOMALY_ADMISSION_OVERLOAD_KEY",
     "OBS_ANOMALY_DEDUP_STORM_KEY",
     "OBS_ANOMALY_ENGINE_DEGRADED_KEY",
+    "OBS_ANOMALY_WAL_CORRUPTION_KEY",
+    "OBS_ANOMALY_WAL_STALL_KEY",
     "OBS_ANOMALY_KEYS",
+    "WAL_FSYNC_RETRY_KEY",
+    "WAL_SCRUB_RUNS_KEY",
+    "WAL_SCRUB_RECORDS_KEY",
+    "WAL_SCRUB_CORRUPTIONS_KEY",
+    "WAL_QUARANTINE_KEY",
+    "WAL_DEGRADED_KEY",
+    "WAL_DEGRADED_TOTAL_KEY",
+    "WAL_STORAGE_KEYS",
     "INGRESS_OFFERED_KEY",
     "INGRESS_ADMITTED_KEY",
     "INGRESS_RATE_LIMITED_KEY",

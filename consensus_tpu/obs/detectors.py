@@ -6,7 +6,7 @@ fixed-seed run is byte-identical across replays.  Detectors are
 EDGE-TRIGGERED: a condition fires once at onset and re-arms only after the
 condition clears, so a 300-second stall is one anomaly, not 300.
 
-The nine kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
+The eleven kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
 
 ``commit_stall``        a running node has pending pool work but its ledger
                         has not grown for ``stall_window`` sim-seconds
@@ -39,13 +39,25 @@ The nine kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
                         below its configured ladder rung (a fault-classed
                         breaker opened — models/supervisor.py); clears when
                         the supervisor re-promotes to rung 0
+``wal_corruption``      the node quarantined a corrupt WAL suffix (boot or
+                        scrub detection) and is fenced as a non-voting
+                        learner until verified sync carries it past the
+                        damage (wal/scrub.py, core/controller.py); clears
+                        when the fence releases
+``wal_stall``           the node's WAL refuses appends — the fsync retry
+                        cap was hit or a write failed (ENOSPC) — so the
+                        node stopped proposing/voting while still serving
+                        sync and reads; clears when an append/probe fsync
+                        succeeds
 
 The two ingress detectors read OPTIONAL health fields
 (``ingress_offered`` / ``ingress_rate_limited`` / ``ingress_dedup_hits``,
-fed by ingress/driver.py), and ``engine_degraded`` reads the optional
+fed by ingress/driver.py), ``engine_degraded`` reads the optional
 ``engine_degraded`` / ``engine_rung`` fields (fed only when a node carries
-an ``engine_supervisor``); samples without them, so every pre-existing
-fixed-seed anomaly stream, are untouched.
+an ``engine_supervisor``), and the two wal detectors read the optional
+``wal_fenced`` / ``wal_degraded`` fields (fed only for file-backed WALs);
+samples without them, so every pre-existing fixed-seed anomaly stream, are
+untouched.
 """
 
 from __future__ import annotations
@@ -64,6 +76,8 @@ ANOMALY_KINDS = (
     "admission_overload",
     "dedup_storm",
     "engine_degraded",
+    "wal_corruption",
+    "wal_stall",
 )
 
 
@@ -304,6 +318,30 @@ class DetectorBank:
                     fired, "engine_degraded", nid, t, bool(degraded),
                     f"supervised verify engine serving at rung "
                     f"{h.get('engine_rung', -1)} (below configured)",
+                )
+
+            # --- wal corruption (fenced learner) -----------------------
+            fenced = h.get("wal_fenced")
+            if fenced is None:
+                # No file-backed WAL on this node: discard the latch so
+                # pre-storage health streams stay byte-identical.
+                self._active.discard(("wal_corruption", nid))
+            else:
+                self._edge(
+                    fired, "wal_corruption", nid, t, bool(fenced),
+                    "durable-state corruption quarantined; fenced as a "
+                    "non-voting learner pending verified sync",
+                )
+
+            # --- wal stall (degraded append path) ----------------------
+            wal_deg = h.get("wal_degraded")
+            if wal_deg is None:
+                self._active.discard(("wal_stall", nid))
+            else:
+                self._edge(
+                    fired, "wal_stall", nid, t, bool(wal_deg),
+                    "WAL refusing appends (fsync/append failures); node "
+                    "stopped proposing and voting until the disk heals",
                 )
 
             # --- verify-launch-rate collapse ---------------------------

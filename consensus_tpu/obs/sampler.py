@@ -202,6 +202,16 @@ class ClusterSampler:
         if sup is not None:
             h["engine_degraded"] = bool(sup.degraded)
             h["engine_rung"] = int(sup.rung)
+        # Optional durable-storage surface: only file-backed WALs carry a
+        # degraded flag (MemWAL does not), so pre-storage samples stay
+        # byte-identical.
+        wal_deg = getattr(wal, "degraded", None)
+        if wal_deg is not None:
+            h["wal_degraded"] = bool(wal_deg)
+            fenced = False
+            if node.running and cons is not None and cons.controller is not None:
+                fenced = bool(cons.controller.health().get("fenced", False))
+            h["wal_fenced"] = fenced
         return h
 
     # --- reads -------------------------------------------------------------

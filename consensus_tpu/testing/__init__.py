@@ -42,6 +42,11 @@ from consensus_tpu.testing.membership import (
     reconfig_request,
 )
 from consensus_tpu.testing.network import INJECTED_EVENT_KINDS, NodeComm, SimNetwork
+from consensus_tpu.testing.storage import (
+    STORAGE_FAULT_CLASSES,
+    FaultyDecisionStore,
+    StorageFaultInjector,
+)
 
 __all__ = [
     "ChaosAction",
@@ -77,4 +82,7 @@ __all__ = [
     "boot_node",
     "install_reconfig_hook",
     "reconfig_request",
+    "STORAGE_FAULT_CLASSES",
+    "FaultyDecisionStore",
+    "StorageFaultInjector",
 ]

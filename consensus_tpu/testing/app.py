@@ -329,6 +329,12 @@ class Node:
         self.synchronizer = None
         #: membership.JoinBootstrap armed by Cluster.add_node(bootstrap=True).
         self.join_bootstrap = None
+        #: Optional testing.storage.StorageFaultInjector: installed over the
+        #: file-backed WAL's open seams at every (re)start.
+        self.storage_injector = None
+        #: Background wal.scrub.WalScrubber (file-backed WAL + a cluster
+        #: ``scrub_interval`` only); torn down with the process on crash.
+        self.scrubber = None
 
     def arm_fault_plan(self, plan) -> None:
         """Arm ``plan`` on this node: its crash seams will call
@@ -362,7 +368,13 @@ class Node:
             self.wal, initial = initialize_and_read_all(
                 os.path.join(self.cluster.wal_dir, f"wal-{self.node_id}"),
                 segment_max_bytes=self.cluster.wal_segment_bytes,
+                quarantine_corrupt=True,
+                # Sim-clocked so the WAL's degraded-mode recovery probe can
+                # arm (without a scheduler an ENOSPC episode never ends).
+                scheduler=self.cluster.scheduler,
             )
+            if self.storage_injector is not None:
+                self.storage_injector.install(self.wal)
         else:
             self.wal = (
                 DeferredMemWAL(self.wal_backing, self.cluster.scheduler, window)
@@ -418,7 +430,42 @@ class Node:
             # crash-matrix trace records exactly which seam fired.
             self.fault_plan.tracer = self.consensus.tracer
         self.consensus.start()
+        inj = self.storage_injector
+        if inj is not None and inj.consume_suspect_fence():
+            # The injector knows this disk dropped or damaged durable bytes
+            # in a way the boot scan could not prove (an fsync lie, an
+            # unscrubbed flip chopped by tail repair): the incarnation
+            # starts as a non-voting learner until verified sync clears it.
+            self.consensus.controller.fence_as_learner(
+                self.consensus.controller.latest_seq()
+            )
+        if (
+            self.cluster.wal_dir is not None
+            and self.cluster.scrub_interval is not None
+        ):
+            from consensus_tpu.wal.scrub import WalScrubber
+
+            self.scrubber = WalScrubber(
+                self.wal,
+                self.cluster.scheduler,
+                interval=self.cluster.scrub_interval,
+                metrics=getattr(self.wal, "_metrics", None),
+                tracer=self.consensus.tracer,
+                on_corruption=self._on_scrub_corruption,
+            )
+            self.scrubber.start()
         self.running = True
+
+    def _on_scrub_corruption(self, err) -> None:
+        """Scrub detection → quarantine the corrupt suffix, fence this
+        replica as a non-voting learner, notify the cluster's hooks (the
+        chaos engine logs + flight-records through them)."""
+        recovery = self.wal.quarantine_corrupt(err)
+        cons = self.consensus
+        if cons is not None and cons.controller is not None:
+            cons.controller.fence_as_learner(cons.controller.latest_seq())
+        for hook in getattr(self.cluster, "corruption_hooks", ()):
+            hook(self.node_id, recovery)
 
     def crash(self) -> None:
         """Hard-stop: drop off the network and kill all components."""
@@ -426,9 +473,15 @@ class Node:
         self.cluster.network.unregister(self.node_id)
         self.cluster.sync_servers.pop(self.node_id, None)
         self.sync_server = None
+        if self.scrubber is not None:
+            self.scrubber.stop()
+            self.scrubber = None
         abandon = getattr(self.wal, "abandon", None)
         if abandon is not None:
             abandon()  # unflushed records / open fds die with the process
+        if self.storage_injector is not None:
+            # A lying disk drops its unsynced suffix exactly at crash time.
+            self.storage_injector.on_crash()
         if self.consensus is not None:
             self.consensus.stop()
             self.consensus = None
@@ -465,6 +518,7 @@ class Cluster:
         durability_window: float = 0.0,
         wal_dir: Optional[str] = None,
         wal_segment_bytes: int = 2048,
+        scrub_interval: Optional[float] = None,
         sync_mode: str = "wire",
         obs=None,
     ) -> None:
@@ -477,6 +531,12 @@ class Cluster:
         #: one; segments deliberately tiny so rolls happen in short runs.
         self.wal_dir = wal_dir
         self.wal_segment_bytes = wal_segment_bytes
+        #: Sim-seconds between background WAL scrub passes (file-backed
+        #: clusters only); None leaves the scrubber off.
+        self.scrub_interval = scrub_interval
+        #: fn(node_id, WALRecovery) called whenever a scrub detection
+        #: quarantines a corrupt suffix (after the node fenced itself).
+        self.corruption_hooks: list = []
         #: "wire" (default) gives every node the real catch-up subsystem
         #: (consensus_tpu/sync/: LedgerSynchronizer over an in-process wire
         #: transport with full codec round-trips and quorum-cert

@@ -79,6 +79,27 @@ Adversary vocabulary (``ChaosAction.kind``):
                                 fault-free run.  ``generate(device_faults=
                                 False)`` consumes no extra RNG, so pinned
                                 schedules replay byte-identically.
+``storage_fault``               storage-fault vocabulary
+                                (``generate(storage_faults=True)`` only):
+                                arm one node's seeded disk-fault injector
+                                (testing/storage.py) — a bit flip in a
+                                committed WAL region, a torn write at an
+                                arbitrary frame offset, a lying fsync
+                                (acked bytes dropped at the next crash), an
+                                ENOSPC byte budget, read EIO, or transient
+                                fsync stalls.  A schedule carrying storage
+                                faults runs the cluster on REAL file-backed
+                                WALs under a temp dir with the background
+                                scrubber (wal/scrub.py) on: a detection
+                                quarantines the corrupt suffix and fences
+                                the node as a non-voting learner until
+                                verified sync carries it past its
+                                checkpoint fence (a commit-path delivery
+                                while fenced is the ``learner-fence``
+                                invariant violation).
+                                ``generate(storage_faults=False)`` consumes
+                                no extra RNG, so pinned schedules replay
+                                byte-identically.
 
 Everything runs on the SimScheduler's virtual clock — no wall-clock reads
 anywhere (scripts/check_no_wallclock.py lints this module too).
@@ -88,6 +109,8 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import shutil
+import tempfile
 from typing import Optional
 
 from consensus_tpu.testing.app import Cluster, make_request
@@ -98,6 +121,10 @@ from consensus_tpu.testing.invariants import (
     is_known_unresolvable_split,
 )
 from consensus_tpu.testing.membership import install_reconfig_hook, reconfig_request
+from consensus_tpu.testing.storage import (
+    STORAGE_FAULT_CLASSES,
+    StorageFaultInjector,
+)
 from consensus_tpu.utils.quorum import compute_quorum
 from consensus_tpu.wire import EpochTagged
 
@@ -117,6 +144,11 @@ DEVICE_FAULT_KINDS = ("device_fault",)
 #: fault taxonomy: ``hang`` -> LaunchTimeout, ``raise`` -> launch raise,
 #: ``flip`` -> verdict corruption (caught by the host cross-check).
 DEVICE_FAULT_CLASSES = ("hang", "raise", "flip")
+
+#: The storage-fault vocabulary: disk-level faults against one node's
+#: file-backed WAL, only drawn when a schedule opts in.  The ``fault`` arg
+#: is one of testing/storage.py's :data:`STORAGE_FAULT_CLASSES`.
+STORAGE_FAULT_KINDS = ("storage_fault",)
 
 #: Geography bank: per-profile region names, intra-region link latency
 #: ``(base, jitter)`` in sim-seconds, and the inter-region latency matrix
@@ -240,6 +272,10 @@ class ChaosSchedule:
     #: Carried so shrunk subsets keep arming the launch-fault injector even
     #: after every ``device_fault`` action was deleted.
     device_faults: bool = False
+    #: True when the schedule was drawn with the storage-fault vocabulary.
+    #: Carried so shrunk subsets keep the file-backed cluster + scrubber
+    #: even after every ``storage_fault`` action was deleted.
+    storage_faults: bool = False
 
     @classmethod
     def generate(
@@ -253,6 +289,7 @@ class ChaosSchedule:
         churn: bool = False,
         wan: Optional[str] = None,
         device_faults: bool = False,
+        storage_faults: bool = False,
     ) -> "ChaosSchedule":
         """Derive a feasible schedule from ``seed``: action times are
         cumulative uniform(5, 40) gaps from ``start``, kinds are weighted
@@ -274,7 +311,16 @@ class ChaosSchedule:
         launch-level hang/raise/verdict-flip faults against the shared
         verify engine, masked at run time by the engine supervisor;
         ``device_faults=False`` consumes no extra RNG, so pre-device-fault
-        schedules replay byte-identically."""
+        schedules replay byte-identically.
+
+        ``storage_faults=True`` adds ``storage_fault`` to the vocabulary:
+        seeded disk faults against one node's file-backed WAL
+        (testing/storage.py).  A faulted node may fence itself as a
+        non-voting learner until verified sync clears it, so storage
+        targets share the crash budget (at most ``f`` replicas down or
+        suspect at once) and each node is faulted at most once per
+        schedule; ``storage_faults=False`` consumes no extra RNG, so
+        pre-storage schedules replay byte-identically."""
         if wan is not None and wan not in WAN_PROFILES:
             raise ValueError(
                 f"unknown WAN profile {wan!r}; "
@@ -296,10 +342,17 @@ class ChaosSchedule:
         if device_faults:
             kinds += list(DEVICE_FAULT_KINDS)
             weights += [1.5]
+        if storage_faults:
+            kinds += list(STORAGE_FAULT_KINDS)
+            weights += [1.5]
         members = set(ids)
         next_id = n + 1
         t = start
         down: set[int] = set()  # crashed or armed-to-crash
+        #: Storage-faulted nodes: they may spend sim-time fenced as
+        #: non-voting learners, so they count against the crash budget and
+        #: are never faulted twice (conservative — most faults heal).
+        suspect: set[int] = set()
         byzantine: set[int] = set()
         actions = []
         for _ in range(steps):
@@ -321,7 +374,12 @@ class ChaosSchedule:
             # Feasibility downgrades keep every generated action applicable
             # (the engine re-checks at run time anyway — shrunk subsets may
             # still strand a restart whose crash was deleted).
-            if kind in ("crash", "arm_fault") and len(down) >= f:
+            if kind == "storage_fault":
+                targets = [i for i in ids
+                           if i not in down and i not in suspect]
+                if not targets or len(down) + len(suspect) >= f:
+                    kind = "heal"
+            if kind in ("crash", "arm_fault") and len(down) + len(suspect) >= f:
                 kind = "restart" if down else "heal"
             if kind == "restart" and not down:
                 kind = "heal"
@@ -396,6 +454,19 @@ class ChaosSchedule:
                     args={"region": region,
                           "factor": rng.choice([2.0, 4.0])},
                 ))
+            elif kind == "storage_fault":
+                node = rng.choice(targets)
+                suspect.add(node)
+                fault = rng.choice(STORAGE_FAULT_CLASSES)
+                args = {"node": node, "fault": fault}
+                if fault == "enospc":
+                    # A zero budget refuses the very next append; positive
+                    # budgets let a few records land first.
+                    args["budget"] = rng.choice([0, 256, 1024])
+                elif fault in ("eio_read", "slow_fsync"):
+                    args["count"] = rng.randrange(1, 4)
+                actions.append(ChaosAction(at=t, kind="storage_fault",
+                                           args=args))
             elif kind == "device_fault":
                 # ``launch`` is RELATIVE: the Kth verify launch after the
                 # action applies faults, so the action stays meaningful in
@@ -416,7 +487,8 @@ class ChaosSchedule:
                 ))
         return cls(seed=seed, n=n, durability_window=durability_window,
                    actions=tuple(actions), wan=wan,
-                   device_faults=device_faults)
+                   device_faults=device_faults,
+                   storage_faults=storage_faults)
 
 
 @dataclasses.dataclass
@@ -529,6 +601,11 @@ class ChaosEngine:
     #: replicas must extend the ledger within this much sim-time of the
     #: post-schedule heal (the liveness invariant's budget).
     LIVENESS_BUDGET = 900.0
+    #: Scrub cadence on storage-fault runs: short relative to the ≥5s
+    #: action gaps, so a latent flip or tear is quarantined (and the node
+    #: fenced) before the adversary can crash the node into the boot-time
+    #: tail-repair path.
+    SCRUB_INTERVAL = 2.0
 
     def __init__(
         self,
@@ -572,6 +649,13 @@ class ChaosEngine:
         wants_faults = bool(device_faults) or any(
             a.kind in DEVICE_FAULT_KINDS for a in schedule.actions
         )
+        #: Storage runs get a real file-backed cluster (temp WAL dir), the
+        #: background scrubber, per-node disk-fault injectors, and the
+        #: learner-fence invariant wired into the delivery hooks.
+        self._wants_storage = schedule.storage_faults or any(
+            a.kind in STORAGE_FAULT_KINDS for a in schedule.actions
+        )
+        self._wal_tmp: Optional[str] = None
         if wants_faults and crypto is None:
             crypto = "ed25519"
         if crypto not in (None, "ed25519", "ed25519-batch", "ed25519-halfagg"):
@@ -791,6 +875,18 @@ class ChaosEngine:
                 return False
             self.fault_injector.arm(args["launch"], args["fault"])
             return True
+        if kind == "storage_fault":
+            node = nodes.get(args["node"])
+            if node is None or args["node"] not in members or not node.running:
+                return False
+            inj = getattr(node, "storage_injector", None)
+            if inj is None:
+                return False
+            inj.arm(
+                args["fault"],
+                **{k: v for k, v in args.items() if k in ("budget", "count")},
+            )
+            return True
         raise ValueError(f"unknown chaos action kind {kind!r}")
 
     def _order_reconfig(self, target_nodes) -> bool:
@@ -970,15 +1066,66 @@ class ChaosEngine:
 
         app.aggregate_cert = aggregate_cert
 
+    def _on_corruption(self, node_id: int, recovery) -> None:
+        """Cluster corruption hook: a scrub detection quarantined a corrupt
+        suffix and the node fenced itself.  Log it deterministically (counts
+        only — never temp paths), heal that node's injector (the fault is
+        consumed), and snapshot a flight record when one is armed."""
+        self._emit(
+            f"{self._now():10.4f} QUARANTINE node={node_id} "
+            f"files={len(recovery.quarantined)} "
+            f"intact={recovery.intact_entries}"
+        )
+        node = self.cluster.nodes.get(node_id)
+        inj = getattr(node, "storage_injector", None)
+        if inj is not None:
+            inj.heal()
+        if self.recorder is not None:
+            self.recorder.trigger(
+                "wal-corruption", node=node_id, detail=recovery.reason
+            )
+
+    def _check_learner_fence(self, node_id: int, decision) -> None:
+        """Delivery-hook invariant: a replica whose WAL lost durable records
+        must not commit (= must not have voted) until verified sync carried
+        it past its fence.  Sync appends bypass deliver(), so any commit-path
+        delivery while ``fence_required()`` means the fence leaked a vote."""
+        node = self.cluster.nodes.get(node_id)
+        cons = node.consensus if node is not None else None
+        if (
+            cons is not None
+            and cons.controller is not None
+            and cons.controller.fence_required()
+        ):
+            self.monitor.record(
+                "learner-fence", node_id,
+                "commit-path delivery while fenced as a non-voting learner "
+                "(voted before verified sync passed the last intact record)",
+            )
+
     # --- the run ------------------------------------------------------------
 
     def run(self) -> ChaosResult:
+        if self._wants_storage:
+            self._wal_tmp = tempfile.mkdtemp(prefix="chaos-wal-")
+        try:
+            return self._run()
+        finally:
+            if self._wal_tmp is not None:
+                shutil.rmtree(self._wal_tmp, ignore_errors=True)
+                self._wal_tmp = None
+
+    def _run(self) -> ChaosResult:
         sched = self.schedule
         self.cluster = Cluster(
             sched.n,
             seed=sched.seed ^ 0xCA05,
             config_tweaks=self.config_tweaks,
             durability_window=sched.durability_window,
+            wal_dir=self._wal_tmp,
+            scrub_interval=(
+                self.SCRUB_INTERVAL if self._wants_storage else None
+            ),
             obs=self.obs,
         )
         if self._churn:
@@ -992,6 +1139,15 @@ class ChaosEngine:
         self.monitor = InvariantMonitor(
             self.cluster, check_durability=self.check_durability
         )
+        if self._wants_storage:
+            for nid, node in self.cluster.nodes.items():
+                # One private RNG stream per node, derived from the schedule
+                # seed: fault targeting replays byte-identically.
+                node.storage_injector = StorageFaultInjector(
+                    seed=sched.seed ^ 0x570A ^ (nid * 7919)
+                )
+            self.cluster.corruption_hooks.append(self._on_corruption)
+            self.cluster.delivery_hooks.append(self._check_learner_fence)
         sampler = self.cluster.sampler
         if sampler is not None:
             if self.tracer is not None:
@@ -1063,6 +1219,14 @@ class ChaosEngine:
             self.cluster.network.mutate_send = None
             self._byz_rules.clear()
             self._disarm_faults()
+            if self._wants_storage:
+                # The disks heal (pending arms cleared; the suspect latch
+                # survives so a lie-truncated node still boots fenced) and
+                # degraded WALs recover on their probe during the settle.
+                for node in self.cluster.nodes.values():
+                    inj = getattr(node, "storage_injector", None)
+                    if inj is not None:
+                        inj.heal()
             members = set(self.cluster.network.node_ids())
             for nid, node in self.cluster.nodes.items():
                 if nid in members and not node.running:
@@ -1217,6 +1381,7 @@ def format_repro(result: ChaosResult) -> str:
         f"    durability_window={s.durability_window!r},",
         f"    wan={s.wan!r},",
         f"    device_faults={s.device_faults!r},",
+        f"    storage_faults={s.storage_faults!r},",
         "    actions=(",
     ]
     for a in s.actions:
@@ -1241,6 +1406,8 @@ __all__ = [
     "DEVICE_FAULT_CLASSES",
     "DEVICE_FAULT_KINDS",
     "FaultInjectingEngine",
+    "STORAGE_FAULT_CLASSES",
+    "STORAGE_FAULT_KINDS",
     "WAN_KINDS",
     "WAN_PROFILES",
     "format_repro",

@@ -30,6 +30,7 @@ Payload = 1 type byte (ENTRY / ANCHOR) + 1 flag byte (truncate_to) + data.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import re
@@ -49,6 +50,18 @@ _SEGMENT_RE = re.compile(r"^(\d{16})\.wal$")
 DEFAULT_SEGMENT_MAX_BYTES = 64 * 1024 * 1024
 _INITIAL_CRC = 0
 
+#: Consecutive group-commit fsync failures tolerated before the log moves
+#: into degraded mode (appends refused, waiters still drain on the retry
+#: timer).  Persist-before-broadcast is unsatisfiable while the disk won't
+#: fsync, so looping silently forever would let the protocol queue unbounded
+#: unpersisted work.
+DEFAULT_FSYNC_RETRY_CAP = 5
+
+#: Subdirectory corrupt segment suffixes are renamed into.  Nothing under it
+#: is ever read back or deleted by this module — the bytes are preserved for
+#: operator forensics while the replica rebuilds through verified sync.
+QUARANTINE_DIRNAME = "quarantine"
+
 
 class WALError(Exception):
     """Base class for WAL failures."""
@@ -66,6 +79,23 @@ class CorruptLogError(WALError):
         self.segment = segment
         self.offset = offset
         self.entries = entries
+
+
+@dataclasses.dataclass(frozen=True)
+class WALRecovery:
+    """What corruption recovery salvaged and set aside.
+
+    Attached to ``WriteAheadLog.recovery`` by the quarantine paths so the
+    embedding node can decide whether it must fence itself as a non-voting
+    learner (it lost durable records it may have acted on) before rejoining
+    the vote."""
+
+    #: Quarantine-relative names of the files renamed aside (never deleted).
+    quarantined: tuple[str, ...]
+    #: Entry records recovered from the intact prefix.
+    intact_entries: int
+    #: The CorruptLogError (or read failure) that triggered recovery.
+    reason: str
 
 
 def _pad(n: int) -> int:
@@ -157,6 +187,27 @@ class WriteAheadLog:
         #: Entries found by :func:`open_`'s validation scan (None for a
         #: freshly created log) — lets boot avoid a second full-disk read.
         self.entries_at_open: Optional[list[bytes]] = None
+        #: Whether the append path is currently refusing work (ENOSPC, or
+        #: the group-commit fsync-retry cap was hit).  Degraded is a MODE,
+        #: not an error: reads and segment scans keep working, and the log
+        #: auto-recovers the moment an append or probe fsync succeeds.
+        self.degraded = False
+        #: Human-readable reason for the current degraded episode.
+        self.degraded_reason: Optional[str] = None
+        #: Callbacks ``fn(degraded: bool)`` fired on every degraded-mode
+        #: transition — the controller fences proposing/voting off this.
+        self.degrade_hooks: list = []
+        #: Set by the quarantine paths; non-None means durable records were
+        #: set aside and the embedding replica may have amnesia.
+        self.recovery: Optional[WALRecovery] = None
+        self._recovery_booked = False
+        self._fsync_failures = 0
+        self._fsync_retry_cap = DEFAULT_FSYNC_RETRY_CAP
+        self._degraded_probe_timer = None
+        #: Injectable file-open seams — testing/storage.py swaps these for
+        #: fault-wrapped opens; production code never touches them.
+        self._open_for_append = open
+        self._open_for_read = open
 
     def attach_consensus_metrics(self, metrics) -> None:
         """Publish the group-commit coalescing ratio
@@ -198,11 +249,15 @@ class WriteAheadLog:
         return wal
 
     @classmethod
-    def open_(cls, directory: str, **kw) -> "WriteAheadLog":
+    def open_(cls, directory: str, repair: bool = False, **kw) -> "WriteAheadLog":
         """Open an existing log for appending after the last intact record.
 
-        Raises :class:`CorruptLogError` if the tail is torn — call
-        :func:`repair` first.  Parity: reference writeaheadlog.go:207-291.
+        ``repair`` makes the open-time contract explicit: ``False`` (the
+        default) raises :class:`CorruptLogError` on a torn tail so the
+        caller decides; ``True`` runs :func:`repair` and retries — tail
+        tears only, non-tail corruption still raises (see
+        :func:`initialize_and_read_all` for the quarantine flow).  Parity:
+        reference writeaheadlog.go:207-291.
         """
         segments = _list_segments(directory)
         if not segments:
@@ -211,15 +266,28 @@ class WriteAheadLog:
         # Validate everything (raises CorruptLogError on damage) and leave
         # the chain CRC positioned after the final record.  The entries are
         # kept so boot (initialize_and_read_all) doesn't rescan the disk.
-        wal.entries_at_open = wal._scan_all()
+        try:
+            wal.entries_at_open = wal._scan_all()
+        except CorruptLogError:
+            if not repair:
+                raise
+            _repair(directory)
+            if not _list_segments(directory):
+                raise WALError(
+                    f"repair removed the only segment in {directory!r}"
+                )
+            return cls.open_(directory, repair=False, **kw)
         last_index, last_name = segments[-1]
         path = os.path.join(directory, last_name)
-        wal._file = open(path, "ab")
+        wal._file = wal._open_for_append(path, "ab")
         wal._segment_index = last_index
         wal._update_file_count()
         return wal
 
     def close(self) -> None:
+        if self._degraded_probe_timer is not None:
+            self._degraded_probe_timer.cancel()
+            self._degraded_probe_timer = None
         if self._sync_timer is not None:
             self._sync_timer.cancel()
             self._sync_timer = None
@@ -245,6 +313,9 @@ class WriteAheadLog:
         :meth:`close`, records whose fsync had not yet happened are simply
         lost — which is exactly what a crash does.  Used by the crash-matrix
         harness; production shutdown should keep using ``close``."""
+        if self._degraded_probe_timer is not None:
+            self._degraded_probe_timer.cancel()
+            self._degraded_probe_timer = None
         if self._sync_timer is not None:
             self._sync_timer.cancel()
             self._sync_timer = None
@@ -284,7 +355,19 @@ class WriteAheadLog:
                 "wal", "wal.append", bytes=len(data), truncate=truncate_to
             )
         flags = _FLAG_TRUNCATE_TO if truncate_to else 0
-        self._write_record(_TYPE_ENTRY, flags, data)
+        try:
+            self._write_record(_TYPE_ENTRY, flags, data)
+        except OSError as err:
+            # ENOSPC/EIO on the write or fsync: persist-before-broadcast is
+            # unsatisfiable, so the log degrades (the controller's degrade
+            # hook stops proposing/voting) instead of letting the replica
+            # keep acting on records that never reached stable storage.
+            self._enter_degraded(f"append failed: {err}")
+            raise WALError(f"append failed: {err}") from err
+        if self.degraded and not self._group_window:
+            # The write (and, in sync mode, its fsync) succeeded: the disk
+            # recovered, so the degraded episode is over.
+            self._exit_degraded()
         if on_durable is not None and self._group_window:
             # Queue BEFORE any eager flush below, so a truncate-triggered
             # flush covers this record's callback too.
@@ -333,10 +416,27 @@ class WriteAheadLog:
                 os.fsync(self._file.fileno())
                 self._count_fsync()
             except OSError:
+                self._fsync_failures += 1
+                if self._metrics is not None:
+                    self._metrics.fsync_retries.add(1)
+                if self._tracer is not None and self._tracer.enabled:
+                    self._tracer.instant(
+                        "wal", "wal.fsync.retry",
+                        consecutive=self._fsync_failures,
+                    )
                 logger.exception(
-                    "WAL group fsync failed; retrying in %.3fs",
+                    "WAL group fsync failed (%d consecutive); retrying in %.3fs",
+                    self._fsync_failures,
                     self._group_window or 0.05,
                 )
+                if self._fsync_failures >= self._fsync_retry_cap:
+                    # Capped: stop pretending this is transient.  The retry
+                    # timer keeps running so queued waiters still drain the
+                    # moment the disk heals, but the replica must stop
+                    # generating new unpersistable work NOW.
+                    self._enter_degraded(
+                        f"fsync retry cap ({self._fsync_retry_cap}) hit"
+                    )
                 if self._scheduler is not None:
                     self._sync_pending = True
                     self._sync_timer = self._scheduler.call_later(
@@ -346,6 +446,9 @@ class WriteAheadLog:
                     )
                     return False
                 raise
+            self._fsync_failures = 0
+            if self.degraded:
+                self._exit_degraded()
         self._sync_pending = False
         waiters, self._sync_waiters = self._sync_waiters, []
         for waiter in waiters:
@@ -357,6 +460,7 @@ class WriteAheadLog:
 
     def _write_record(self, rtype: int, flags: int, data: bytes) -> None:
         payload = bytes([rtype, flags]) + data
+        prev_crc = self._crc
         self._crc = zlib.crc32(payload, self._crc) & 0xFFFFFFFF
         frame = _HEADER.pack(len(payload), self._crc) + payload + b"\x00" * _pad(
             len(payload)
@@ -370,8 +474,15 @@ class WriteAheadLog:
                 self._file.flush()
                 os.fsync(self._file.fileno())
             plan.crash("wal.append.torn_write")
-        self._file.write(frame)
-        self._file.flush()
+        try:
+            self._file.write(frame)
+            self._file.flush()
+        except OSError:
+            # The frame did not (fully) reach the file: rewind the chain CRC
+            # so a later successful append continues from the last record
+            # that actually landed on disk.
+            self._crc = prev_crc
+            raise
         if rtype == _TYPE_ENTRY:
             self._records_since_fsync += 1
         if self._sync:
@@ -391,12 +502,142 @@ class WriteAheadLog:
                 if plan is not None and rtype == _TYPE_ENTRY:
                     plan.crash("wal.fsync.post")
 
+    # --- degraded mode & quarantine ---------------------------------------
+
+    def _enter_degraded(self, reason: str) -> None:
+        if self.degraded:
+            return
+        self.degraded = True
+        self.degraded_reason = reason
+        logger.warning("WAL degraded: %s (appends refused)", reason)
+        if self._metrics is not None:
+            self._metrics.degraded.set(1)
+            self._metrics.degraded_transitions.add(1)
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant("wal", "wal.degraded", reason=reason)
+        for hook in list(self.degrade_hooks):
+            try:
+                hook(True)
+            except Exception:
+                logger.exception("WAL degrade hook failed; continuing")
+        self._arm_degraded_probe()
+
+    def _exit_degraded(self) -> None:
+        if not self.degraded:
+            return
+        self.degraded = False
+        self.degraded_reason = None
+        self._fsync_failures = 0
+        if self._degraded_probe_timer is not None:
+            self._degraded_probe_timer.cancel()
+            self._degraded_probe_timer = None
+        logger.info("WAL recovered from degraded mode; appends resume")
+        if self._metrics is not None:
+            self._metrics.degraded.set(0)
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant("wal", "wal.recovered")
+        for hook in list(self.degrade_hooks):
+            try:
+                hook(False)
+            except Exception:
+                logger.exception("WAL degrade hook failed; continuing")
+
+    def _arm_degraded_probe(self) -> None:
+        # The group-commit retry timer already doubles as a recovery probe
+        # (its success path exits degraded mode); only arm a dedicated probe
+        # when no retry is in flight and a scheduler exists to clock it.
+        if (
+            self._scheduler is None
+            or self._sync_timer is not None
+            or self._degraded_probe_timer is not None
+        ):
+            return
+        self._degraded_probe_timer = self._scheduler.call_later(
+            max(self._group_window, 0.05),
+            self._probe_degraded,
+            name="wal-degraded-probe",
+        )
+
+    def _probe_degraded(self) -> None:
+        self._degraded_probe_timer = None
+        if not self.degraded or self._closed or self._file is None:
+            return
+        try:
+            self._file.flush()
+            if self._sync:
+                os.fsync(self._file.fileno())
+        except OSError:
+            self._arm_degraded_probe()
+            return
+        self._exit_degraded()
+
+    def quarantine_corrupt(self, err: CorruptLogError) -> WALRecovery:
+        """Live-quarantine the corrupt suffix (scrub detection path): move
+        the damaged segment's suffix and every later segment into the
+        quarantine directory, then reopen positioned after the last intact
+        record.  Pending group-commit durability callbacks are DROPPED —
+        records in the lost suffix can never be reported durable, and the
+        embedding replica is expected to fence itself and rebuild through
+        verified sync (see ``recovery``)."""
+        if self._sync_timer is not None:
+            self._sync_timer.cancel()
+            self._sync_timer = None
+        self._sync_pending = False
+        self._sync_waiters = []
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        moved = quarantine(self._dir, err)
+        segments = _list_segments(self._dir)
+        if not segments:
+            self._crc = _INITIAL_CRC
+            entries: list[bytes] = []
+            self._start_segment(1)
+        else:
+            entries = self._scan_all()  # repositions the chain CRC
+            last_index, last_name = segments[-1]
+            self._file = self._open_for_append(
+                os.path.join(self._dir, last_name), "ab"
+            )
+            self._segment_index = last_index
+        self._update_file_count()
+        self.recovery = WALRecovery(
+            quarantined=tuple(moved),
+            intact_entries=len(entries),
+            reason=str(err),
+        )
+        self._book_recovery()
+        return self.recovery
+
+    def _book_recovery(self) -> None:
+        """Book the quarantine exactly once, whenever metrics are ready
+        (boot-path quarantines happen before attach_metrics)."""
+        if self.recovery is None or self._recovery_booked:
+            return
+        if self._metrics is None:
+            return
+        self._recovery_booked = True
+        self._metrics.quarantines.add(1)
+        self._metrics.scrub_corruptions.add(1)
+        if self._tracer is not None and self._tracer.enabled:
+            self._tracer.instant(
+                "wal", "wal.quarantine",
+                files=len(self.recovery.quarantined),
+                intact=self.recovery.intact_entries,
+            )
+
     def attach_metrics(self, metrics) -> None:
         """Attach a MetricsWAL bundle after construction (the facade calls
         this: the embedder builds the WAL before the metrics provider is
         known) and publish the current file count."""
         self._metrics = metrics
         self._update_file_count()
+        if self.degraded:
+            metrics.degraded.set(1)
+        self._book_recovery()
 
     def _update_file_count(self) -> None:
         if self._metrics is not None:
@@ -410,7 +651,7 @@ class WriteAheadLog:
                 self._count_fsync()
             self._file.close()
         path = os.path.join(self._dir, _segment_name(index))
-        self._file = open(path, "ab")
+        self._file = self._open_for_append(path, "ab")
         self._segment_index = index
         # Anchor: carries the running chain CRC so this segment can be
         # validated without its predecessors.
@@ -447,7 +688,7 @@ class WriteAheadLog:
         first = True
         for _, name in _list_segments(self._dir):
             path = os.path.join(self._dir, name)
-            with open(path, "rb") as f:
+            with self._open_for_read(path, "rb") as f:
                 buf = f.read()
             crc, first = self._scan_segment(name, buf, crc, first, entries)
         self._crc = crc
@@ -557,21 +798,101 @@ def repair(directory: str) -> None:
     _fsync_dir(directory)
 
 
+# open_(repair=...) shadows the module function with its parameter name;
+# this alias keeps the call reachable from inside the class.
+_repair = repair
+
+
+def quarantine(directory: str, err: CorruptLogError) -> list[str]:
+    """Set aside the corrupt suffix, preserving the intact prefix.
+
+    The damaged segment (from the corruption offset) and every later
+    segment are RENAMED into ``quarantine/`` — never deleted: the replica
+    may have broadcast votes recorded in those bytes, so they stay on disk
+    for operator forensics while the node rebuilds through verified sync.
+    When the corruption sits mid-segment, the segment's intact prefix (a
+    whole number of records, ending just before ``err.offset``) is written
+    back so those entries survive.  Returns the quarantined file names.
+    """
+    qdir = os.path.join(directory, QUARANTINE_DIRNAME)
+    os.makedirs(qdir, exist_ok=True)
+    bad_index = None
+    for index, name in _list_segments(directory):
+        if name == err.segment:
+            bad_index = index
+            break
+    if bad_index is None:
+        raise WALError(
+            f"quarantine: segment {err.segment!r} not found in {directory!r}"
+        )
+    moved: list[str] = []
+    for index, name in _list_segments(directory):
+        if index < bad_index:
+            continue
+        src = os.path.join(directory, name)
+        prefix = None
+        if name == err.segment and err.offset > 0:
+            with open(src, "rb") as f:
+                prefix = f.read(err.offset)
+        dst = os.path.join(qdir, name)
+        bump = 0
+        while os.path.exists(dst):
+            bump += 1
+            dst = os.path.join(qdir, f"{name}.{bump}")
+        os.replace(src, dst)
+        moved.append(os.path.basename(dst))
+        if prefix is not None:
+            with open(src, "wb") as f:
+                f.write(prefix)
+                f.flush()
+                os.fsync(f.fileno())
+    _fsync_dir(qdir)
+    _fsync_dir(directory)
+    return moved
+
+
 def initialize_and_read_all(
-    directory: str, **kw
+    directory: str, quarantine_corrupt: bool = False, **kw
 ) -> tuple[WriteAheadLog, list[bytes]]:
     """Boot-time flow: create a fresh log, or open an existing one (repairing
     a torn tail if needed) and return its entries.
 
-    Parity: reference pkg/wal/writeaheadlog.go:754-810.
+    ``quarantine_corrupt`` enables the amnesia-safe path: corruption beyond
+    the tail (which :func:`repair` refuses — durable records were damaged
+    at rest) no longer kills the boot.  The corrupt suffix is quarantined,
+    the log reopens from the intact prefix, and ``wal.recovery`` carries
+    what was lost so the embedding replica fences itself as a non-voting
+    learner until verified sync passes a checkpoint above the intact
+    prefix.  Parity: reference pkg/wal/writeaheadlog.go:754-810 (original
+    repair-only flow).
     """
     os.makedirs(directory, exist_ok=True)
     if not _list_segments(directory):
         return WriteAheadLog.create(directory, **kw), []
     try:
         wal = WriteAheadLog.open_(directory, **kw)
-    except CorruptLogError:
-        repair(directory)
+    except CorruptLogError as err:
+        try:
+            repair(directory)
+        except WALError:
+            if not quarantine_corrupt:
+                raise
+            moved = quarantine(directory, err)
+            if not _list_segments(directory):
+                wal = WriteAheadLog.create(directory, **kw)
+                entries: list[bytes] = []
+            else:
+                wal = WriteAheadLog.open_(directory, **kw)
+                entries = (
+                    wal.entries_at_open
+                    if wal.entries_at_open is not None else []
+                )
+            wal.recovery = WALRecovery(
+                quarantined=tuple(moved),
+                intact_entries=len(entries),
+                reason=str(err),
+            )
+            return wal, entries
         if not _list_segments(directory):
             # The only segment was damaged beyond its anchor: start fresh.
             return WriteAheadLog.create(directory, **kw), []
@@ -583,7 +904,11 @@ __all__ = [
     "WriteAheadLog",
     "WALError",
     "CorruptLogError",
+    "WALRecovery",
     "repair",
+    "quarantine",
     "initialize_and_read_all",
     "DEFAULT_SEGMENT_MAX_BYTES",
+    "DEFAULT_FSYNC_RETRY_CAP",
+    "QUARANTINE_DIRNAME",
 ]

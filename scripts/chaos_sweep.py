@@ -40,6 +40,18 @@ pre-device-fault sweeps.
 
     python scripts/chaos_sweep.py --start 0 --count 50 --device-faults
 
+``--storage-faults`` adds the disk-fault vocabulary to every schedule:
+``storage_fault`` actions arm per-node storage injectors (bit flips,
+torn writes, fsync lies, ENOSPC, read errors, fsync stalls) beneath a
+real file-backed WAL with the background scrubber running; corrupt
+suffixes must be quarantined, amnesiac replicas must rejoin as fenced
+learners, and a seed fails exactly when an invariant (including
+``learner-fence``) is violated.  Per-seed JSON lines gain the storage
+telemetry (``quarantines`` plus every injected fault that fired).
+Without it, schedules are byte-identical to pre-storage-fault sweeps.
+
+    python scripts/chaos_sweep.py --start 0 --count 50 --storage-faults
+
 Every seed runs with the observability plane sampling (read-only: ledgers
 and verdicts are identical to an unsampled run) and emits one per-seed JSON
 line with its anomaly-detector counts and the final health snapshot of
@@ -83,6 +95,7 @@ def run_sweep(args) -> int:
             seed, n=args.nodes, steps=args.steps,
             durability_window=args.window, churn=args.churn,
             wan=args.wan, device_faults=args.device_faults,
+            storage_faults=args.storage_faults,
         )
         # cert_mode="half-agg" needs an aggregation-capable verifier, so it
         # implies the real-crypto harness; "full" keeps the seed-identical
@@ -107,6 +120,16 @@ def run_sweep(args) -> int:
                 {"launch": launch, "fault": fault}
                 for launch, fault in engine.fault_injector.fired
             ]
+        if args.storage_faults:
+            fired = []
+            nodes = engine.cluster.nodes if engine.cluster is not None else {}
+            for nid, node in sorted(nodes.items()):
+                inj = getattr(node, "storage_injector", None)
+                for kind, detail in (inj.fired if inj is not None else ()):
+                    fired.append({"node": nid, "fault": kind,
+                                  "detail": detail})
+            record["storage_faults_fired"] = fired
+            record["quarantines"] = result.event_log.count(b"QUARANTINE")
         print(json.dumps(record, sort_keys=True))
         if result.ok:
             if args.verbose:
@@ -142,6 +165,7 @@ def run_sweep(args) -> int:
             "churn": args.churn,
             "wan": args.wan,
             "device_faults": args.device_faults,
+            "storage_faults": args.storage_faults,
             "cert_mode": args.cert_mode,
         },
     }
@@ -177,6 +201,13 @@ def main() -> int:
                          "to each schedule's vocabulary; implies real "
                          "Ed25519 crypto and an engine supervisor that "
                          "must mask every injected fault")
+    ap.add_argument("--storage-faults", action="store_true",
+                    help="add storage_fault actions (bit flip / torn write "
+                         "/ fsync lie / ENOSPC / read error / fsync stall "
+                         "against per-node disk injectors) to each "
+                         "schedule's vocabulary; runs on a real "
+                         "file-backed WAL with the scrubber, quarantine, "
+                         "and learner-fence invariant armed")
     ap.add_argument("--cert-mode", choices=("full", "half-agg"),
                     default="full",
                     help='quorum-cert format: "half-agg" runs every seed '

@@ -211,6 +211,35 @@ def test_chaos_sweep_script_smoke():
     assert summary["params"]["steps"] == 8
 
 
+def test_chaos_sweep_script_storage_faults():
+    """--storage-faults sweeps run on real file-backed WALs and surface
+    the storage telemetry in the per-seed JSON lines.  Seed 3 at steps=25
+    draws an eio_read fault, so its record must carry a fired fault and a
+    quarantine count; the summary params pin the flag for replayability."""
+    import json
+
+    proc, summary = _run_sweep_script("--start", "2", "--count", "2",
+                                      "--steps", "25", "--storage-faults")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert summary["failed"] == 0
+    assert summary["params"]["storage_faults"] is True
+    records = []
+    for line in proc.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "seed" in obj:
+            records.append(obj)
+    assert [r["seed"] for r in records] == [2, 3]
+    for r in records:
+        assert "storage_faults_fired" in r
+        assert "quarantines" in r
+    fired = [f for r in records for f in r["storage_faults_fired"]]
+    assert any(f["fault"] == "eio_read" for f in fired), fired
+    assert any(r["quarantines"] >= 1 for r in records), records
+
+
 @pytest.mark.slow
 def test_chaos_sweep_script_wide():
     proc, summary = _run_sweep_script("--start", "1000", "--count", "60")

@@ -544,6 +544,133 @@ def test_sync_corrupted_chunk_fails_closed_and_survived():
     cluster.assert_ledgers_consistent()
 
 
+# --- storage-fault matrix cells --------------------------------------------
+#
+# The cells above kill the PROCESS at instrumented seams; these fault the
+# DISK under a live process (testing/storage.py) and then add the crash:
+# every cell must come back to a consistent, progressing cluster with the
+# faulted replica re-admitted to voting only through the sanctioned path
+# (degraded-mode exit, or the learner fence releasing after verified sync).
+
+
+def _storage_cell(tmp_path, fault_seed=0):
+    seed = _seed("storage", str(fault_seed))
+    cluster = Cluster(
+        4,
+        seed=seed,
+        config_tweaks=dict(FAST),
+        wal_dir=str(tmp_path),
+        wal_segment_bytes=512,
+        scrub_interval=2.0,
+    )
+    from consensus_tpu.testing import StorageFaultInjector
+
+    for nid, node in cluster.nodes.items():
+        node.storage_injector = StorageFaultInjector(seed=fault_seed + nid)
+    cluster.start()
+    return cluster, cluster.nodes[VICTIM]
+
+
+def _drive_decisions(cluster, tag, count, ids=None):
+    for i in range(count):
+        cluster.submit_to_all(make_request(tag, i))
+        base = max(len(n.app.ledger) for n in cluster.nodes.values())
+        assert cluster.run_until_ledger(
+            base + 1, max_time=300.0, node_ids=ids
+        ), f"{tag}: no progress at decision {i}"
+
+
+def test_storage_cell_scrub_flip_then_crash_reboots_fenced(tmp_path):
+    """Bit flip → scrub quarantine → fence; then the victim CRASHES while
+    fenced.  The next boot finds the quarantined (clean) WAL plus the
+    injector's suspect latch, re-fences, and re-enters voting only via the
+    release bound."""
+    cluster, victim = _storage_cell(tmp_path, fault_seed=11)
+    _drive_decisions(cluster, "pre", 3)
+    victim.storage_injector.arm("bit_flip")
+    assert cluster.scheduler.run_until(
+        lambda: victim.wal.recovery is not None, max_time=60.0
+    ), "scrub never quarantined the flipped record"
+    assert victim.consensus.controller.fence_required()
+    victim.crash()
+    victim.restart()
+    ctrl = victim.consensus.controller
+    assert ctrl.fence_required(), "reboot after quarantine+crash must fence"
+    _drive_decisions(cluster, "post", 3, ids=[n for n in cluster.nodes if n != VICTIM])
+    assert cluster.scheduler.run_until(
+        lambda: not ctrl.fence_required(), max_time=1800.0
+    ), "fence never released after verified sync"
+    _drive_decisions(cluster, "rec", 2)
+    cluster.assert_ledgers_consistent()
+
+
+def test_storage_cell_quarantine_then_rejoin(tmp_path):
+    """Torn mid-frame write → live quarantine → learner fence → release:
+    the canonical self-healing path, under the matrix FAST config."""
+    cluster, victim = _storage_cell(tmp_path, fault_seed=23)
+    _drive_decisions(cluster, "pre", 3)
+    victim.storage_injector.arm("torn_mid")
+    cluster.submit_to_all(make_request("torn", 0))
+    assert cluster.scheduler.run_until(
+        lambda: victim.wal.recovery is not None, max_time=60.0
+    ), "torn frame never quarantined"
+    ctrl = victim.consensus.controller
+    assert ctrl.fence_required()
+    victim.storage_injector.heal()
+    for i in range(8):
+        cluster.submit_to_all(make_request("fill", i))
+    assert cluster.scheduler.run_until(
+        lambda: not ctrl.fence_required(), max_time=1800.0
+    ), "fence never released"
+    _drive_decisions(cluster, "rec", 2)
+    cluster.assert_ledgers_consistent()
+
+
+def test_storage_cell_enospc_degrade_crash_recover(tmp_path):
+    """Full disk → degraded (voting suspended, nothing forgotten); the
+    victim then crashes and restarts.  A remount heals the budget, so the
+    reboot needs NO fence — it rejoins voting directly."""
+    cluster, victim = _storage_cell(tmp_path, fault_seed=37)
+    _drive_decisions(cluster, "pre", 3)
+    victim.storage_injector.arm("enospc", budget=0)
+    cluster.submit_to_all(make_request("full", 0))
+    assert cluster.scheduler.run_until(
+        lambda: victim.wal.degraded, max_time=60.0
+    ), "full disk never degraded the WAL"
+    assert victim.consensus.controller.health()["wal_degraded"] is True
+    victim.crash()
+    victim.restart()
+    ctrl = victim.consensus.controller
+    assert not victim.wal.degraded, "remount must clear the transient budget"
+    assert not ctrl.fence_required(), "ENOSPC forgets nothing: no fence"
+    _drive_decisions(cluster, "rec", 3)
+    cluster.assert_ledgers_consistent()
+
+
+def test_storage_cell_fsync_lie_crash_boots_fenced(tmp_path):
+    """Lying fsyncs + crash: the truncated tail is locally undetectable, so
+    the next incarnation boots fenced and rejoins only after verified sync
+    passes the release bound."""
+    cluster, victim = _storage_cell(tmp_path, fault_seed=41)
+    _drive_decisions(cluster, "pre", 3)
+    victim.storage_injector.arm("fsync_lie")
+    _drive_decisions(cluster, "lied", 3)
+    victim.crash()
+    assert any(
+        k == "fsync_lie" for k, _ in victim.storage_injector.fired
+    ), "the lie never materialized at crash time"
+    victim.restart()
+    ctrl = victim.consensus.controller
+    assert ctrl.fence_required(), "amnesiac reboot must fence as a learner"
+    for i in range(8):
+        cluster.submit_to_all(make_request("fill", i))
+    assert cluster.scheduler.run_until(
+        lambda: not ctrl.fence_required(), max_time=1800.0
+    ), "fence never released"
+    _drive_decisions(cluster, "rec", 2)
+    cluster.assert_ledgers_consistent()
+
+
 # --- zero-overhead guarantee ----------------------------------------------
 
 

@@ -139,3 +139,29 @@ def test_lint_covers_models_aggregate():
     assert proc.returncode == 0, (
         "crypto models have wall-clock reads:\n" + proc.stdout + proc.stderr
     )
+
+
+def test_lint_covers_storage_fault_layer():
+    """The storage-fault injector (testing/storage.py) and the WAL scrubber
+    (wal/scrub.py) both promise seed-deterministic, injected-clock-only
+    behavior — chaos schedules with storage faults replay byte-identically
+    only if neither ever reads real time.  Pin the lint's coverage of both
+    trees, presence of the modules first."""
+    testing_dir = os.path.join(_REPO, "consensus_tpu", "testing")
+    wal_dir = os.path.join(_REPO, "consensus_tpu", "wal")
+    assert "storage.py" in {
+        f for f in os.listdir(testing_dir) if f.endswith(".py")
+    }
+    assert {"scrub.py", "log.py"} <= {
+        f for f in os.listdir(wal_dir) if f.endswith(".py")
+    }
+    for root in (testing_dir, wal_dir):
+        proc = subprocess.run(
+            [sys.executable, _SCRIPT, root],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, (
+            f"storage-fault tree {root} has wall-clock reads:\n"
+            + proc.stdout + proc.stderr
+        )

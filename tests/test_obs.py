@@ -202,8 +202,40 @@ def test_leader_churn_schedule_fires_storm_and_flap_detectors():
     churn_kinds = {"membership_churn"}
     ingress_kinds = {"admission_overload", "dedup_storm"}
     engine_kinds = {"engine_degraded"}  # tests/test_supervisor.py end-to-end
+    # tests/test_obs.py wal-detector units + tests/test_storage_faults.py
+    # fire the storage pair end-to-end.
+    storage_kinds = {"wal_corruption", "wal_stall"}
     assert (partition_kinds | churn_kinds | ingress_kinds | engine_kinds
-            | set(counts) >= set(ANOMALY_KINDS))
+            | storage_kinds | set(counts) >= set(ANOMALY_KINDS))
+
+
+def test_wal_corruption_and_stall_detectors_edge_trigger():
+    from consensus_tpu.obs.detectors import DetectorBank
+
+    bank = DetectorBank()
+
+    def sample(t, fenced, degraded):
+        h = {"running": True, "ledger": 1, "pool": 0}
+        if fenced is not None:
+            h["wal_fenced"] = fenced
+        if degraded is not None:
+            h["wal_degraded"] = degraded
+        return [a.kind for a in bank.evaluate(t, {2: h})]
+
+    # MemWAL node (no wal health fields): nothing fires, ever.
+    assert sample(0.0, None, None) == []
+    # Rising edges fire exactly once each.
+    assert sample(1.0, True, False) == ["wal_corruption"]
+    assert sample(2.0, True, False) == []  # latched while it holds
+    assert sample(3.0, True, True) == ["wal_stall"]
+    assert sample(4.0, True, True) == []
+    # Falling edges clear the latch; the next rise refires.
+    assert sample(5.0, False, False) == []
+    assert sample(6.0, True, False) == ["wal_corruption"]
+    # A restart that loses the file-backed WAL (fields vanish) discards the
+    # latch instead of leaving it stuck.
+    assert sample(7.0, None, None) == []
+    assert sample(8.0, True, False) == ["wal_corruption"]
 
 
 def test_detector_firings_are_deterministic():

@@ -1,0 +1,469 @@
+"""Storage-fault tolerance end-to-end: the deterministic fault injector
+(testing/storage.py), the background scrubber (wal/scrub.py), live
+quarantine + learner fencing (core/controller.py), ENOSPC degraded mode,
+and the chaos-schedule ``storage_fault`` vocabulary — including the seeded
+SENTINEL_EAGER_UNFENCE bug that the learner-fence invariant must catch and
+the shrinker must localize.
+"""
+
+import logging
+import os
+
+import pytest
+
+import consensus_tpu.core.controller as controller_mod
+from consensus_tpu.metrics import InMemoryProvider, Metrics
+from consensus_tpu.runtime import SimScheduler
+from consensus_tpu.testing import (
+    STORAGE_FAULT_CLASSES,
+    Cluster,
+    FaultyDecisionStore,
+    StorageFaultInjector,
+    make_request,
+)
+from consensus_tpu.testing.chaos import (
+    ChaosAction,
+    ChaosEngine,
+    ChaosSchedule,
+    shrink,
+)
+from consensus_tpu.wal import (
+    WALError,
+    WalScrubber,
+    WriteAheadLog,
+    initialize_and_read_all,
+)
+
+
+def entries_of(n, size=24):
+    return [bytes([i % 256]) * size for i in range(1, n + 1)]
+
+
+def wal_with_injector(tmp_path, *, seed=1, metrics=None, **kw):
+    d = str(tmp_path / "wal")
+    sched = SimScheduler()
+    wal, _ = initialize_and_read_all(d, scheduler=sched, **kw)
+    if metrics is not None:
+        wal.attach_metrics(metrics.wal)
+    inj = StorageFaultInjector(seed=seed)
+    inj.install(wal)
+    return wal, inj, sched
+
+
+# --- injector units ---------------------------------------------------------
+
+
+def test_injector_rejects_unknown_fault(tmp_path):
+    _, inj, _ = wal_with_injector(tmp_path)
+    with pytest.raises(ValueError):
+        inj.arm("meteor_strike")
+
+
+def test_injector_is_deterministic(tmp_path):
+    firings = []
+    for _ in range(2):
+        d = tmp_path / f"run{len(firings)}"
+        d.mkdir()
+        wal, inj, _ = wal_with_injector(d, seed=99)
+        for e in entries_of(8):
+            wal.append(e)
+        inj.arm("bit_flip")
+        wal.close()
+        firings.append(inj.fired)
+    assert firings[0] == firings[1]
+    assert firings[0][0][0] == "bit_flip"
+
+
+def test_bit_flip_lands_in_record_bytes_and_scrub_detects(tmp_path):
+    wal, inj, sched = wal_with_injector(tmp_path, seed=5)
+    for e in entries_of(10):
+        wal.append(e)
+    inj.arm("bit_flip")
+    # The flip targets header/payload bytes only (never CRC-exempt
+    # padding), so a chain re-walk must always detect it.
+    scrubber = WalScrubber(wal, sched, interval=1.0)
+    err = scrubber.scrub_now()
+    assert err is not None
+    assert scrubber.corruptions == 1
+    assert inj.consume_suspect_fence() is True
+    assert inj.consume_suspect_fence() is False  # consumed exactly once
+
+
+def test_torn_mid_write_keeps_tear_as_durable_tail(tmp_path):
+    wal, inj, sched = wal_with_injector(tmp_path, seed=3)
+    for e in entries_of(4):
+        wal.append(e)
+    inj.arm("torn_mid")
+    with pytest.raises(WALError):
+        wal.append(b"torn-victim")
+    assert wal.degraded  # append failed mid-write
+    assert inj.fired[0][0] == "torn_mid"
+    # The device went read-only: later appends bounce instead of landing
+    # past the tear (which boot repair would then mistake for the tail).
+    with pytest.raises(WALError):
+        wal.append(b"after-tear")
+    # A scrub pass sees the torn frame and the quarantine path recovers
+    # the intact prefix.
+    scrubber = WalScrubber(wal, sched, interval=1.0)
+    err = scrubber.scrub_now()
+    assert err is not None and "torn" in str(err)
+    inj.heal()
+    recovery = wal.quarantine_corrupt(err)
+    assert recovery.intact_entries == 4
+    assert wal.read_all() == entries_of(4)
+    wal.append(b"post-recovery")
+    assert wal.read_all()[-1] == b"post-recovery"
+
+
+def test_enospc_budget_degrades_then_probe_recovers(tmp_path):
+    metrics = Metrics(InMemoryProvider())
+    wal, inj, sched = wal_with_injector(tmp_path, seed=2, metrics=metrics)
+    wal.append(b"pre")
+    inj.arm("enospc", budget=0)
+    with pytest.raises(WALError):
+        wal.append(b"refused")
+    assert wal.degraded
+    # The probe alone must not lie the mode healthy while writes bounce:
+    # a hard-full device refuses flushes too.
+    sched.advance(5.0)
+    assert wal.degraded
+    assert metrics.wal.degraded_transitions.value == 1
+    inj.heal()
+    sched.advance(5.0)
+    assert not wal.degraded
+    assert metrics.wal.degraded_transitions.value == 1  # one episode, one entry
+    assert metrics.wal.degraded.value == 0
+    wal.append(b"post")
+    assert wal.read_all() == [b"pre", b"post"]
+
+
+def test_fsync_lie_drops_unsynced_suffix_at_crash(tmp_path):
+    wal, inj, _ = wal_with_injector(tmp_path, seed=4)
+    for e in entries_of(3):
+        wal.append(e)
+    inj.arm("fsync_lie")
+    for e in entries_of(5)[3:]:
+        wal.append(e)
+    wal.abandon()
+    inj.on_crash()
+    assert any(k == "fsync_lie" for k, _ in inj.fired)
+    assert inj.consume_suspect_fence() is True
+    # Everything after the arm evaporated; the prefix survived intact.
+    reopened, entries = initialize_and_read_all(str(tmp_path / "wal"))
+    assert entries == entries_of(3)
+    reopened.close()
+
+
+def test_eio_read_surfaces_as_scrub_corruption_at_offset_zero(tmp_path):
+    wal, inj, sched = wal_with_injector(tmp_path, seed=6)
+    for e in entries_of(3):
+        wal.append(e)
+    inj.arm("eio_read", count=1)
+    scrubber = WalScrubber(wal, sched, interval=1.0)
+    err = scrubber.scrub_now()
+    assert err is not None and err.offset == 0
+    # One-shot: the quarantine rescan that follows can read again.
+    assert scrubber.scrub_now() is None
+
+
+def test_slow_fsync_books_retries_in_group_commit_mode(tmp_path):
+    metrics = Metrics(InMemoryProvider())
+    wal, inj, sched = wal_with_injector(
+        tmp_path, seed=7, metrics=metrics, group_commit_window=0.05
+    )
+    inj.arm("slow_fsync", count=2)
+    fired = []
+    wal.append(b"a", on_durable=lambda: fired.append("a"))
+    sched.advance(1.0)
+    # Two injected failures, each booked as a pinned retry; durability was
+    # never reported early and the callback fired after the disk healed.
+    assert metrics.wal.fsync_retries.value == 2
+    assert fired == ["a"]
+    assert not wal.degraded
+
+
+def test_fsync_retry_cap_enters_degraded_then_recovers(tmp_path):
+    metrics = Metrics(InMemoryProvider())
+    wal, inj, sched = wal_with_injector(
+        tmp_path, seed=8, metrics=metrics, group_commit_window=0.05
+    )
+    cap = wal._fsync_retry_cap
+    inj.arm("slow_fsync", count=cap + 2)
+    fired = []
+    wal.append(b"a", on_durable=lambda: fired.append("a"))
+    sched.run_until(lambda: wal.degraded, max_time=60.0)
+    assert wal.degraded
+    assert metrics.wal.fsync_retries.value >= cap
+    assert fired == []  # no false durability while the disk is refusing
+    # The retry timer keeps probing; once the stall drains, the queued
+    # waiter completes and degraded mode exits on its own.
+    sched.run_until(lambda: not wal.degraded, max_time=60.0)
+    assert not wal.degraded
+    assert fired == ["a"]
+    assert metrics.wal.degraded_transitions.value == 1
+
+
+def test_faulty_decision_store_fails_reads_then_delegates():
+    class Mem:
+        def __init__(self):
+            self.rows = []
+
+        def height(self):
+            return len(self.rows)
+
+        def read(self, a, b):
+            return self.rows[a - 1 : b]
+
+        def append(self, d):
+            self.rows.append(d)
+
+        def last(self):
+            return self.rows[-1] if self.rows else None
+
+    store = FaultyDecisionStore(Mem())
+    store.append(b"d1")
+    store.fail_reads = 1
+    with pytest.raises(OSError):
+        store.read(1, 1)
+    assert store.read(1, 1) == [b"d1"]
+    assert store.fired == 1
+    assert store.height() == 1 and store.last() == b"d1"
+
+
+# --- cluster-level recovery flows -------------------------------------------
+
+
+def build_cluster(tmp_path, *, seed=7):
+    d = str(tmp_path / "cluster")
+    os.makedirs(d, exist_ok=True)
+    c = Cluster(
+        4,
+        seed=seed,
+        wal_dir=d,
+        scrub_interval=2.0,
+        config_tweaks={"view_change_resend_interval": 2.0},
+    )
+    for nid, node in c.nodes.items():
+        node.metrics = Metrics(InMemoryProvider())
+        node.storage_injector = StorageFaultInjector(seed=100 + nid)
+    c.start()
+    return c
+
+
+def drive(c, start, count, ids=None):
+    for i in range(start, start + count):
+        c.submit_to_all(make_request("cli", i))
+        h = max(len(n.app.ledger) for n in c.nodes.values())
+        assert c.run_until_ledger(h + 1, max_time=120, node_ids=ids), (
+            f"no progress at request {i}"
+        )
+
+
+def test_cluster_scrub_detects_flip_quarantines_and_fence_releases(tmp_path):
+    c = build_cluster(tmp_path)
+    drive(c, 0, 5)
+    node = c.nodes[2]
+    inj = node.storage_injector
+    wal = node.wal
+    ctrl = node.consensus.controller
+    inj.arm("bit_flip")
+    # Background scrub catches the latent flip, the suffix quarantines,
+    # and the node fences itself as a non-voting learner.
+    assert c.scheduler.run_until(lambda: wal.recovery is not None, max_time=60)
+    assert ctrl.fence_required()
+    assert ctrl.health()["fenced"] is True
+    assert wal._metrics.quarantines.value == 1
+    assert wal._metrics.scrub_corruptions.value >= 1
+    inj.heal()
+    # Traffic keeps flowing; verified sync carries the learner past the
+    # release bound and it resumes voting.
+    for i in range(100, 108):
+        c.submit_to_all(make_request("cli", i))
+    assert c.scheduler.run_until(lambda: not ctrl.fence_required(), max_time=300)
+    assert wal._metrics.quarantines.value == 1  # exactly one per fault
+    drive(c, 200, 2)
+    c.assert_ledgers_consistent()
+
+
+def test_cluster_enospc_degrades_others_progress_then_recovers(tmp_path):
+    c = build_cluster(tmp_path)
+    drive(c, 0, 3)
+    node = c.nodes[3]
+    inj = node.storage_injector
+    wal = node.wal
+    ctrl = node.consensus.controller
+    inj.arm("enospc", budget=0)
+    c.submit_to_all(make_request("cli", 100))
+    assert c.scheduler.run_until(lambda: wal.degraded, max_time=60)
+    assert ctrl.health()["wal_degraded"] is True
+    # n - 1 = 3 healthy replicas still commit while the full disk holds
+    # one replica out of the voter set.
+    drive(c, 101, 2, ids=[1, 2, 4])
+    inj.heal()
+    assert c.scheduler.run_until(lambda: not wal.degraded, max_time=60)
+    assert wal._metrics.degraded_transitions.value == 1
+    drive(c, 200, 2)
+    c.assert_ledgers_consistent()
+
+
+def test_cluster_fsync_lie_crash_boots_fenced_then_rejoins(tmp_path):
+    c = build_cluster(tmp_path)
+    drive(c, 0, 3)
+    node = c.nodes[2]
+    inj = node.storage_injector
+    inj.arm("fsync_lie")
+    drive(c, 100, 3)
+    node.crash()
+    assert any(k == "fsync_lie" for k, _ in inj.fired)
+    # The lying disk dropped post-arm bytes at crash; the next incarnation
+    # cannot prove that from local state, so it boots fenced.
+    node.restart()
+    ctrl = node.consensus.controller
+    assert ctrl.fence_required()
+    for i in range(200, 208):
+        c.submit_to_all(make_request("cli", i))
+    assert c.scheduler.run_until(lambda: not ctrl.fence_required(), max_time=300)
+    c.assert_ledgers_consistent()
+
+
+def test_cluster_torn_write_quarantine_then_rejoin(tmp_path):
+    c = build_cluster(tmp_path)
+    drive(c, 0, 3)
+    node = c.nodes[2]
+    inj = node.storage_injector
+    wal = node.wal
+    ctrl = node.consensus.controller
+    inj.arm("torn_mid")
+    c.submit_to_all(make_request("cli", 100))
+    assert c.scheduler.run_until(lambda: wal.recovery is not None, max_time=60)
+    assert ctrl.fence_required()
+    assert wal._metrics.quarantines.value == 1
+    inj.heal()
+    for i in range(101, 109):
+        c.submit_to_all(make_request("cli", i))
+    assert c.scheduler.run_until(lambda: not ctrl.fence_required(), max_time=300)
+    c.assert_ledgers_consistent()
+
+
+# --- chaos vocabulary -------------------------------------------------------
+
+
+def test_generate_storage_faults_off_is_byte_identical():
+    base = ChaosSchedule.generate(42, n=4, steps=25)
+    off = ChaosSchedule.generate(42, n=4, steps=25, storage_faults=False)
+    assert [(a.at, a.kind, a.args) for a in base.actions] == [
+        (a.at, a.kind, a.args) for a in off.actions
+    ]
+
+
+def test_generate_storage_faults_stay_inside_fault_model():
+    for seed in range(20):
+        sched = ChaosSchedule.generate(seed, n=4, steps=30, storage_faults=True)
+        f = 1
+        down, suspect = set(), set()
+        for act in sched.actions:
+            if act.kind in ("crash", "arm_fault"):
+                down.add(act.args["node"])
+            elif act.kind == "restart":
+                down.discard(act.args["node"])
+            elif act.kind == "storage_fault":
+                assert act.args["fault"] in STORAGE_FAULT_CLASSES
+                assert act.args["node"] not in suspect, "node faulted twice"
+                suspect.add(act.args["node"])
+            assert len(down) + len(suspect) <= f, (
+                f"seed {seed}: crashed+suspect exceeds f"
+            )
+
+
+#: Per-class engine seeds: generate(seed, n=4, steps=25, storage_faults=True)
+#: draws exactly this fault class (pinned; regenerate with a sweep over
+#: seeds if the generator's RNG layout ever changes deliberately).
+MATRIX_SEEDS = {
+    "bit_flip": 2,
+    "eio_read": 3,
+    "fsync_lie": 6,
+    "torn_mid": 8,
+    "slow_fsync": 17,
+    "enospc": 28,
+}
+
+#: Corruption-class faults quarantine; availability-class faults only
+#: degrade (or, for fsync_lie, materialize at a crash).
+QUARANTINE_CLASSES = {"bit_flip", "eio_read", "torn_mid"}
+
+
+@pytest.mark.parametrize("fault", sorted(MATRIX_SEEDS))
+def test_chaos_matrix_per_fault_class(fault):
+    seed = MATRIX_SEEDS[fault]
+    sched = ChaosSchedule.generate(seed, n=4, steps=25, storage_faults=True)
+    drawn = [a.args["fault"] for a in sched.actions if a.kind == "storage_fault"]
+    assert fault in drawn, f"seed {seed} no longer draws {fault}"
+    result = ChaosEngine(sched).run()
+    assert result.ok, result.violation
+    quarantines = result.event_log.count(b"QUARANTINE")
+    if fault in QUARANTINE_CLASSES and set(drawn) <= QUARANTINE_CLASSES:
+        assert quarantines == len(drawn), (
+            f"expected one quarantine per injected {fault}"
+        )
+
+
+def test_chaos_storage_run_replays_byte_identically():
+    sched = ChaosSchedule.generate(2, n=4, steps=25, storage_faults=True)
+    a = ChaosEngine(sched).run()
+    b = ChaosEngine(
+        ChaosSchedule.generate(2, n=4, steps=25, storage_faults=True)
+    ).run()
+    assert a.event_log == b.event_log
+
+
+# --- the seeded eager-unfence sentinel --------------------------------------
+
+#: A corrupt-then-keep-voting schedule: the bit flip at 35 s is scrubbed
+#: and quarantined well before the end; the trailing actions are noise for
+#: the shrinker to strip.
+EAGER_UNFENCE_SCHEDULE = ChaosSchedule(
+    seed=11,
+    n=4,
+    durability_window=0.0,
+    storage_faults=True,
+    actions=(
+        ChaosAction(at=35.0, kind="storage_fault",
+                    args={"node": 2, "fault": "bit_flip"}),
+        ChaosAction(at=50.0, kind="loss", args={"a": 1, "b": 3, "p": 0.2}),
+        ChaosAction(at=65.0, kind="delay", args={"a": 3, "b": 4, "d": 0.2}),
+        ChaosAction(at=80.0, kind="heal"),
+    ),
+)
+
+
+@pytest.fixture
+def eager_unfence_bug():
+    controller_mod.SENTINEL_EAGER_UNFENCE = True
+    try:
+        yield
+    finally:
+        controller_mod.SENTINEL_EAGER_UNFENCE = False
+
+
+def test_learner_fence_invariant_catches_eager_unfence(eager_unfence_bug):
+    result = ChaosEngine(EAGER_UNFENCE_SCHEDULE).run()
+    assert not result.ok
+    v = result.violation
+    assert v.invariant == "learner-fence"
+    assert v.node == 2
+    assert b"VIOLATION learner-fence" in result.event_log
+
+
+def test_schedule_is_clean_without_the_sentinel():
+    result = ChaosEngine(EAGER_UNFENCE_SCHEDULE).run()
+    assert result.ok, result.violation
+
+
+def test_shrinker_localizes_eager_unfence(eager_unfence_bug):
+    small, res = shrink(EAGER_UNFENCE_SCHEDULE, invariant="learner-fence")
+    assert res.violation.invariant == "learner-fence"
+    # The storage fault is the only action that can fence node 2: it must
+    # survive shrinking, and the noise must not.
+    kinds = [a.kind for a in small.actions]
+    assert "storage_fault" in kinds
+    assert len(small.actions) <= 2, small.actions
