@@ -233,33 +233,39 @@ def bench_prep_breakdown(msgs, sigs, keys) -> dict:
     }
 
 
-#: shards × batch sweep for the mesh_verify column family.  Shard counts
+#: topology × batch sweep for the mesh_verify column family.  Topologies
 #: are filtered to the devices actually visible (a v5e-1 reports the 1-shard
 #: row only; a host mesh with XLA_FLAGS=--xla_force_host_platform_device_count
-#: fills the sweep on CPU).
-MESH_SHARD_SWEEP = (1, 2, 4, 8)
+#: fills the sweep on CPU).  1-D entries are the historical shard sweep; the
+#: 2-D entries run the SAME device counts laid out over named ("slice",
+#: "batch") axes, so 1-D vs 2-D at equal devices isolates what the device
+#: layout (ICI adjacency of the psum tree) buys — verdict math is identical.
+MESH_TOPOLOGY_SWEEP = ("1", "2", "4", "8", "2x2", "2x4")
 MESH_BATCH_SWEEP = (2048, 16384)
 
 
 def bench_mesh_verify(msgs, sigs, keys) -> dict:
     """``mesh_verify`` column family: the sharded strict engine
     (parallel/sharding.py shard_map lane) timed through ``verify_batch``
-    across a shards × batch sweep.  The headline ``value`` is the largest
-    shard count at the largest batch; ``vs_single_shard`` answers "what did
-    the mesh buy over one device at the same batch"."""
+    across a topology × batch sweep (1-D and 2-D layouts at equal device
+    counts).  The headline ``value`` is the widest topology at the largest
+    batch, ``topology`` records which layout that was, and
+    ``vs_single_shard`` answers "what did the mesh buy over one device at
+    the same batch"."""
     import jax
 
-    from consensus_tpu.parallel.sharding import (
-        ShardedEd25519Verifier,
-        mesh_for_shards,
-    )
+    from consensus_tpu.parallel.sharding import ShardedEd25519Verifier
+    from consensus_tpu.parallel.topology import MeshTopology
 
     n_dev = len(jax.devices())
-    shard_counts = [s for s in MESH_SHARD_SWEEP if s <= n_dev] or [1]
+    topologies = [
+        t for t in (MeshTopology.parse(s) for s in MESH_TOPOLOGY_SWEEP)
+        if t.shard_count <= n_dev
+    ] or [MeshTopology((1,))]
     batches = sorted({min(b, len(msgs)) for b in MESH_BATCH_SWEEP})
     sweep = {}
-    for shards in shard_counts:
-        verifier = ShardedEd25519Verifier(mesh_for_shards(shards))
+    for topo in topologies:
+        verifier = ShardedEd25519Verifier(topo)
         for batch in batches:
             m, s, k = msgs[:batch], sigs[:batch], keys[:batch]
             ok = verifier.verify_batch(m, s, k)  # warmup compile per shape
@@ -268,13 +274,15 @@ def bench_mesh_verify(msgs, sigs, keys) -> dict:
             for _ in range(DEVICE_ITERS):
                 assert verifier.verify_batch(m, s, k).all()
             elapsed = time.perf_counter() - start
-            sweep[f"{shards}x{batch}"] = batch * DEVICE_ITERS / elapsed
-    head = sweep[f"{shard_counts[-1]}x{batches[-1]}"]
-    single = sweep[f"1x{batches[-1]}"]
+            sweep[f"{topo.label}@{batch}"] = batch * DEVICE_ITERS / elapsed
+    head_topo = max(topologies, key=lambda t: (t.shard_count, t.ndim))
+    head = sweep[f"{head_topo.label}@{batches[-1]}"]
+    single = sweep[f"1@{batches[-1]}"]
     return {
         "sweep": {key: round(rate, 1) for key, rate in sweep.items()},
         "value": round(head, 1),
         "unit": "sigs/sec",
+        "topology": head_topo.label,
         "vs_single_shard": round(head / single, 3),
     }
 
@@ -561,8 +569,12 @@ def _save_last_good(
     *,
     unit: str = "sigs/sec",
     hardware: str = "v5e-1 via tunnel",
+    topology: str = "",
 ) -> None:
-    """Refresh the measurement trail after a successful live run."""
+    """Refresh the measurement trail after a successful live run.
+    ``topology`` (the mesh_verify headline's device layout, e.g. "8" or
+    "2x4") rides along so both the live record and a later structured-skip
+    replay of this entry say which layout the number came from."""
     try:
         with open(LAST_GOOD_PATH) as fh:
             data = json.load(fh)
@@ -584,6 +596,8 @@ def _save_last_good(
         "date": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "hardware": hardware,
     }
+    if topology:
+        data[metric]["topology"] = topology
     tmp = LAST_GOOD_PATH + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(data, fh, indent=2)
@@ -924,6 +938,7 @@ def main() -> None:
                 "ed25519_mesh_verify_throughput",
                 mesh_record["value"],
                 mesh_record["vs_single_shard"],
+                topology=mesh_record["topology"],
             )
     _save_last_good(metric, device_rate, device_rate / host_rate)
     record = {

@@ -184,12 +184,16 @@ ENGINE_RECOVERED_KEY = "engine_recovered_total"
 ENGINE_CROSSCHECK_KEY = "engine_crosscheck_total"
 ENGINE_CROSSCHECK_MISMATCH_KEY = "engine_crosscheck_mismatch_total"
 ENGINE_RUNG_KEY = "engine_rung"
+ENGINE_COMPILE_CACHE_HITS_KEY = "engine_compile_cache_hits_total"
+ENGINE_COMPILE_CACHE_MISSES_KEY = "engine_compile_cache_misses_total"
 ENGINE_KEYS = (
     ENGINE_DEGRADE_KEY,
     ENGINE_RECOVERED_KEY,
     ENGINE_CROSSCHECK_KEY,
     ENGINE_CROSSCHECK_MISMATCH_KEY,
     ENGINE_RUNG_KEY,
+    ENGINE_COMPILE_CACHE_HITS_KEY,
+    ENGINE_COMPILE_CACHE_MISSES_KEY,
 )
 
 #: THE module-level registry of every pinned instrument name: key -> one-line
@@ -306,6 +310,12 @@ PINNED_METRIC_KEYS: dict[str, str] = {
         "host cross-checks that contradicted the device verdict",
     ENGINE_RUNG_KEY:
         "current degrade-ladder rung (0 = as configured; gauge)",
+    ENGINE_COMPILE_CACHE_HITS_KEY:
+        "engine constructions that reused an already-traced kernel from "
+        "the in-process compiled-kernel memo",
+    ENGINE_COMPILE_CACHE_MISSES_KEY:
+        "engine constructions that traced a kernel fresh (first build of "
+        "that topology, or the memo disabled)",
 }
 
 
@@ -1001,6 +1011,16 @@ class MetricsEngine(_Bundle):
         self.rung = p.new_gauge(
             ENGINE_RUNG_KEY,
             "Current degrade-ladder rung (0 = as configured).",
+            ln,
+        )
+        self.count_compile_cache_hits = p.new_counter(
+            ENGINE_COMPILE_CACHE_HITS_KEY,
+            "Engine constructions that reused a memoized compiled kernel.",
+            ln,
+        )
+        self.count_compile_cache_misses = p.new_counter(
+            ENGINE_COMPILE_CACHE_MISSES_KEY,
+            "Engine constructions that traced a kernel fresh.",
             ln,
         )
 

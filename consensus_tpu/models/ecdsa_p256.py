@@ -212,6 +212,19 @@ class EcdsaP256BatchVerifier:
         self._min_device_batch = min_device_batch
         self._pad_to = pad_to
 
+    @property
+    def preferred_wave_size(self) -> int:
+        """The smallest padded batch that saturates this engine (see the
+        Ed25519 twin) — coalescers read it to size cross-tenant waves."""
+        from consensus_tpu.parallel.topology import engine_padded_size
+
+        return engine_padded_size(
+            max(1, self._min_device_batch),
+            1,
+            pad_to=self._pad_to,
+            pad_pow2=self._pad_pow2,
+        )
+
     @staticmethod
     def _batch_invert_mod_n(values: list[int]) -> list[int]:
         """Montgomery batch inversion mod the group order: ONE modular

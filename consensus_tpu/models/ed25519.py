@@ -277,6 +277,21 @@ class Ed25519BatchVerifier:
         self._pad_to = pad_to
         self._device = device
 
+    @property
+    def preferred_wave_size(self) -> int:
+        """The smallest padded batch that saturates this engine — the
+        device-batch floor rounded through the padding knobs.  Coalescers
+        (models/engine.py) read it to size cross-tenant waves; the mesh
+        engines override it with the whole-slice shard multiple."""
+        from consensus_tpu.parallel.topology import engine_padded_size
+
+        return engine_padded_size(
+            max(1, self._min_device_batch),
+            1,
+            pad_to=self._pad_to,
+            pad_pow2=self._pad_pow2,
+        )
+
     def _prepare(
         self,
         messages: Sequence[bytes],

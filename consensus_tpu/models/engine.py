@@ -118,6 +118,24 @@ class _Pending:
         self.waiterless = waiterless
 
 
+def _slice_wave_target(engine, cap: int) -> int:
+    """The early-flush signature count for a coalescer over ``engine``.
+
+    Multi-device engines advertise ``preferred_wave_size`` — the smallest
+    padded wave that saturates the WHOLE topology (every shard fed at least
+    its device-batch floor), not one chip — so once that many signatures
+    are aboard the coalescer launches without waiting out the window:
+    the slice is already full, further waiting is pure latency.  Engines
+    without a multi-device topology keep the plain size cap, so
+    single-device coalescing behavior is bit-for-bit unchanged."""
+    if int(getattr(engine, "shard_count", 1) or 1) <= 1:
+        return cap
+    preferred = int(getattr(engine, "preferred_wave_size", 0) or 0)
+    if preferred <= 0:
+        return cap
+    return min(cap, preferred)
+
+
 class ThreadCoalescingVerifier:
     """Thread-safe verify coalescer for replicas *sharing one device*.
 
@@ -176,6 +194,9 @@ class ThreadCoalescingVerifier:
         self._engine = engine
         self._window = window
         self._max_batch = max_batch
+        # Early-flush point: the engine's slice-filling wave size on mesh
+        # engines, the plain cap otherwise (see _slice_wave_target).
+        self._flush_target = _slice_wave_target(engine, max_batch)
         self._hard_cap = hard_cap if hard_cap > 0 else max(max_batch, 1)
         self._bypass_below = bypass_below
         self._host_fallback = getattr(engine, "verify_host", None)
@@ -390,7 +411,7 @@ class ThreadCoalescingVerifier:
                 if not self._pending and self._closed:
                     return
                 deadline = time.monotonic() + self._window  # wallclock-ok
-                while self._count < self._max_batch and not self._closed:
+                while self._count < self._flush_target and not self._closed:
                     remaining = deadline - time.monotonic()  # wallclock-ok
                     if remaining <= 0:
                         break
@@ -492,10 +513,14 @@ class FairShareWaveFormer:
       one whole submission per tenant per pass, and the rotation order
       advances every wave, so a heavy tenant gets the leftover capacity
       but can never exclude a light one from the next launch.
-    * **Deadline-aware coalescing** — a wave closes when ``max_wave``
-      signatures are aboard or ``window`` seconds after the first pending
-      submission, whichever is first; until then, cross-tenant submissions
-      keep joining the same launch.
+    * **Deadline-aware coalescing** — a wave closes when the flush target
+      is aboard or ``window`` seconds after the first pending submission,
+      whichever is first; until then, cross-tenant submissions keep joining
+      the same launch.  The flush target is ``max_wave``, except over a
+      mesh engine, where the former learns the engine's
+      ``preferred_wave_size`` — the padded shard-multiple that saturates
+      the whole slice — and launches as soon as the slice is full instead
+      of waiting out the window.
 
     ``on_wave(tenant_counts, total)`` fires after each successful launch
     with the per-tenant signature counts that rode it — the sidecar's
@@ -516,6 +541,9 @@ class FairShareWaveFormer:
         self._engine = engine
         self._window = window
         self._max_wave = max(1, max_wave)
+        # Early-flush point: the engine's slice-filling wave size on mesh
+        # engines, the plain cap otherwise (see _slice_wave_target).
+        self._wave_target = _slice_wave_target(engine, self._max_wave)
         self._tenant_queue_limit = max(1, tenant_queue_limit)
         self._on_wave = on_wave
         self._wait_timeout = wait_timeout
@@ -618,7 +646,7 @@ class FairShareWaveFormer:
                 # Real-thread deadline: wave closes at first-pending + window
                 # or the size cap, whichever fires first.
                 deadline = time.monotonic() + self._window  # wallclock-ok
-                while self._count < self._max_wave and not self._closed:
+                while self._count < self._wave_target and not self._closed:
                     remaining = deadline - time.monotonic()  # wallclock-ok
                     if remaining <= 0:
                         break

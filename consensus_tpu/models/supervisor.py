@@ -314,10 +314,11 @@ class EngineSupervisor:
         return label
 
     def __getattr__(self, attr):
-        # Engine-shape attributes (randomized, pad_to, min_device_batch, …)
-        # come from the PRIMARY rung: callers size batches for the engine
-        # they configured, and degrades must not change wire-visible
-        # semantics mid-flight (SAFETY §12).
+        # Engine-shape attributes (randomized, pad_to, min_device_batch,
+        # shard_count, preferred_wave_size, …) come from the PRIMARY rung:
+        # callers size batches — and coalescers size slice-filling waves —
+        # for the engine they configured, and degrades must not change
+        # wire-visible semantics mid-flight (SAFETY §12).
         if attr.startswith("_"):
             raise AttributeError(attr)
         return getattr(self._rungs[0], attr)

@@ -46,119 +46,106 @@ def raw_message(data: bytes) -> bytes:
     return _RAW_TAG + data
 
 
-def engine_for_config(config, curve: str = "ed25519"):
+def engine_for_config(config, curve: str = "ed25519", *, metrics=None):
     """The batch engine matching a ``Configuration``'s crypto knobs
     (``batch_verify_mode``, ``crypto_pad_pow2``, ``crypto_tpu_min_batch``,
-    ``mesh_shards``, ``device_prep``).  ``mesh_shards > 1`` selects the
-    sharded engines from :mod:`consensus_tpu.parallel` over a mesh of that
-    many devices; ``mesh_shards = 1`` returns today's single-device engines
+    ``mesh_shards`` / ``mesh_topology``, ``device_prep``), routed through
+    the engine registry (:mod:`consensus_tpu.models.registry`): the config
+    maps to an ``EngineKey`` and an unregistered key fails loudly with the
+    curve-specific reason.  A multi-device topology — ``mesh_shards > 1``
+    or a non-empty ``mesh_topology`` such as ``(2, 4)`` — selects the
+    sharded engines from :mod:`consensus_tpu.parallel` over that device
+    layout; ``mesh_shards = 1`` returns today's single-device engines
     bit-for-bit.  ``device_prep`` swaps in the fused bytes-in → verdict-out
     engines (:mod:`consensus_tpu.models.fused`) on either topology.  Every
     replica in a cluster must agree on the VERDICT-affecting knobs
     (``batch_verify_mode``, the curve) — verdict parity across replicas is
-    a quorum-safety requirement; ``mesh_shards`` and ``device_prep`` only
-    change the launch topology and may differ per replica.
+    a quorum-safety requirement; the topology knobs and ``device_prep``
+    only change the launch layout and may differ per replica.
+
+    ``config.compile_cache`` governs construction cost: the in-process
+    compiled-kernel memo means rebuilding an engine over the same topology
+    (restart, supervisor ladder, tenant churn) books zero new compiles in
+    the kernel ledger, and a non-empty ``persistent_dir`` additionally
+    wires jax's on-disk compilation cache.  Pass a node ``Metrics`` bundle
+    as ``metrics`` to book this construction's memo hits/misses into the
+    pinned ``engine_compile_cache_{hits,misses}_total`` counters.
 
     ``engine_supervision`` wraps the result in an
     :class:`~consensus_tpu.models.supervisor.EngineSupervisor` over the
     config's degrade ladder (:func:`degrade_ladder_configs`): fault-classed
     circuit breakers route launches down fused → unfused → host (and
-    N shards → single device → host) and re-promote when the breaker
+    mesh → single device → host) and re-promote when the breaker
     closes.  Supervision, too, changes only WHERE work runs — never the
     verdict — so it is per-replica free."""
-    if not getattr(config, "engine_supervision", False):
-        return _engine_for_config(config, curve)
-    from consensus_tpu.models.supervisor import EngineSupervisor
+    from consensus_tpu.obs.kernels import COMPILE_CACHE
 
-    rungs = [_engine_for_config(c, curve) for c in degrade_ladder_configs(config)]
-    return EngineSupervisor(
-        rungs,
-        crosscheck_interval=int(
-            getattr(config, "engine_crosscheck_interval", 0) or 0
-        ),
-        name=f"{curve}-engine",
-    )
+    before = COMPILE_CACHE.snapshot()
+    if not getattr(config, "engine_supervision", False):
+        engine = _engine_for_config(config, curve)
+    else:
+        from consensus_tpu.models.supervisor import EngineSupervisor
+
+        rungs = [
+            _engine_for_config(c, curve) for c in degrade_ladder_configs(config)
+        ]
+        engine = EngineSupervisor(
+            rungs,
+            crosscheck_interval=int(
+                getattr(config, "engine_crosscheck_interval", 0) or 0
+            ),
+            name=f"{curve}-engine",
+        )
+    if metrics is not None:
+        after = COMPILE_CACHE.snapshot()
+        metrics.engine.count_compile_cache_hits.add(
+            after["hits"] - before["hits"]
+        )
+        metrics.engine.count_compile_cache_misses.add(
+            after["misses"] - before["misses"]
+        )
+    return engine
 
 
 def degrade_ladder_configs(config) -> list:
     """The best-first ``Configuration`` ladder supervision degrades down:
-    as configured, then N mesh shards → single device, then fused → unfused
-    host-prep.  (The host twin is not a config — the supervisor appends it
-    as the ladder's floor itself.)"""
+    as configured, then mesh → single device, then fused → unfused
+    host-prep.  Derived by walking the engine registry's degrade keys
+    (:meth:`~consensus_tpu.models.registry.EngineRegistry.degrade_keys`)
+    and mapping each key transition back onto the config, so the ladder
+    always mirrors what is actually registered.  (The host twin is not a
+    config — the supervisor appends it as the ladder's floor itself.)"""
+    from consensus_tpu.models.registry import ENGINE_REGISTRY, engine_key_for
+
     ladder = [config]
-    if int(getattr(config, "mesh_shards", 1) or 1) > 1:
-        ladder.append(ladder[-1].with_(mesh_shards=1))
-    if bool(getattr(config, "device_prep", False)):
-        ladder.append(ladder[-1].with_(device_prep=False))
+    keys = ENGINE_REGISTRY.degrade_keys(engine_key_for(config))
+    for prev_key, next_key in zip(keys, keys[1:]):
+        prev = ladder[-1]
+        if prev_key.topology == "mesh" and next_key.topology == "single":
+            ladder.append(prev.with_(mesh_shards=1, mesh_topology=()))
+        elif prev_key.device_prep and not next_key.device_prep:
+            ladder.append(prev.with_(device_prep=False))
     return ladder
 
 
 def _engine_for_config(config, curve: str = "ed25519"):
-    """The unsupervised engine routing (see :func:`engine_for_config`)."""
-    randomized = bool(getattr(config, "batch_verify_mode", False))
-    fused = bool(getattr(config, "device_prep", False))
-    shards = int(getattr(config, "mesh_shards", 1) or 1)
-    kw = dict(
+    """The unsupervised engine routing (see :func:`engine_for_config`):
+    config -> ``EngineKey`` -> registered builder."""
+    from consensus_tpu.models.registry import ENGINE_REGISTRY, engine_key_for
+    from consensus_tpu.parallel.topology import (
+        apply_compile_cache,
+        topology_for_config,
+    )
+
+    cache = getattr(config, "compile_cache", None)
+    apply_compile_cache(cache)
+    return ENGINE_REGISTRY.build(
+        engine_key_for(config, curve),
+        topology=topology_for_config(config),
+        compile_cache=bool(getattr(cache, "enabled", True)),
         pad_pow2=config.crypto_pad_pow2,
         min_device_batch=config.crypto_tpu_min_batch,
     )
-    if curve == "p256":
-        if randomized:
-            raise ValueError(
-                "batch_verify_mode is Ed25519-only (no randomized P-256 lane)"
-            )
-        if fused:
-            raise ValueError(
-                "device_prep is Ed25519-only (no fused P-256 front-end)"
-            )
-        from consensus_tpu.models.ecdsa_p256 import EcdsaP256BatchVerifier
-
-        if shards > 1:
-            from consensus_tpu.parallel import (
-                ShardedEcdsaP256Verifier,
-                mesh_for_shards,
-            )
-
-            return ShardedEcdsaP256Verifier(mesh_for_shards(shards), **kw)
-        return EcdsaP256BatchVerifier(**kw)
-    if curve != "ed25519":
-        raise ValueError(f"unknown curve {curve!r}")
-    if shards > 1:
-        from consensus_tpu.parallel import (
-            ShardedEd25519RandomizedVerifier,
-            ShardedEd25519Verifier,
-            ShardedFusedEd25519RandomizedVerifier,
-            ShardedFusedEd25519Verifier,
-            mesh_for_shards,
-        )
-
-        if fused:
-            cls = (
-                ShardedFusedEd25519RandomizedVerifier
-                if randomized
-                else ShardedFusedEd25519Verifier
-            )
-        else:
-            cls = (
-                ShardedEd25519RandomizedVerifier
-                if randomized
-                else ShardedEd25519Verifier
-            )
-        return cls(mesh_for_shards(shards), **kw)
-    if fused:
-        from consensus_tpu.models.fused import (
-            FusedEd25519BatchVerifier,
-            FusedEd25519RandomizedBatchVerifier,
-        )
-
-        cls = (
-            FusedEd25519RandomizedBatchVerifier
-            if randomized
-            else FusedEd25519BatchVerifier
-        )
-        return cls(**kw)
-    cls = Ed25519RandomizedBatchVerifier if randomized else Ed25519BatchVerifier
-    return cls(**kw)
 
 
 class Ed25519Signer(Signer):

@@ -21,7 +21,10 @@ requests flow through a :class:`consensus_tpu.models.engine
 .FairShareWaveFormer`: per-tenant bounded queues with admission control
 (structured reject — status 2 — never a stall), round-robin fair-share
 draining, and deadline-aware cross-tenant coalescing so four channels'
-quorum certs ride ONE mesh launch.  Per-tenant metrics land in a
+quorum certs ride ONE mesh launch.  Over a mesh engine the former learns
+the engine's ``preferred_wave_size`` (the padded shard-multiple that
+saturates the whole slice, not one chip) and launches as soon as the
+slice is full rather than waiting out the window.  Per-tenant metrics land in a
 :class:`consensus_tpu.metrics.MetricsSidecar` bundle and per-tenant kernel
 attribution in :data:`consensus_tpu.obs.kernels.TENANT_KERNELS`.
 

@@ -124,6 +124,40 @@ class TenantAccounting:
 TENANT_KERNELS = TenantAccounting()
 
 
+class CompileCacheStats:
+    """Hit/miss ledger for the in-process compiled-kernel memo
+    (parallel/sharding.py ``compiled_kernel``).
+
+    A *hit* means an engine construction reused an already-traced jit
+    wrapper — the retrace storm a fleet restart or tenant churn would have
+    paid; a *miss* is a fresh build (first construction of that
+    ``(kernel, topology[, shape])`` key, or the memo disabled via
+    ``CompileCacheConfig.enabled=False``).  Surfaced through the node
+    metrics bundle as ``engine_compile_cache_{hits,misses}_total``.
+    """
+
+    def __init__(self) -> None:
+        self.hits = 0
+        self.misses = 0
+
+    def record(self, *, hit: bool) -> None:
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+
+    def snapshot(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses}
+
+    def reset(self) -> None:
+        self.hits = 0
+        self.misses = 0
+
+
+#: Process-wide compiled-kernel memo ledger (fed by parallel/sharding.py).
+COMPILE_CACHE = CompileCacheStats()
+
+
 def _cache_size(jitted) -> int:
     try:
         return int(jitted._cache_size())
@@ -180,6 +214,8 @@ def instrumented_jit(
 
 
 __all__ = [
+    "COMPILE_CACHE",
+    "CompileCacheStats",
     "KERNELS",
     "KernelRegistry",
     "KernelStats",

@@ -2,24 +2,38 @@
 
 The verification workload is pure data parallelism: every signature's
 double-scalar multiplication is independent, so the natural multi-chip
-layout is a 1-D mesh with the batch axis sharded across it.  Collectives
-only appear at the reduction edge (the validity count / all-valid bit),
-where a ``psum`` rides the ICI.
+layout is a mesh with the batch axis sharded across it.  Collectives only
+appear at the reduction edge (the validity count / all-valid bit), where a
+``psum`` rides the ICI.
+
+Topologies come from :class:`~consensus_tpu.parallel.topology.MeshTopology`:
+a 1-D ``(n,)`` spec (the ``mesh_shards=n`` sugar) builds the historical
+``("batch",)`` mesh bit-for-bit, while an N-D spec such as ``(2, 4)`` names
+its leading axes (``("slice", "batch")``) and shards the batch dimension
+over the FULL axis tuple — the per-lane math, padding, and verdicts are
+identical at equal device counts; only the device layout the runtime maps
+onto the physical interconnect changes.
 
 Two entry points:
 
-* :func:`sharded_verify` — ``shard_map`` of the kernel body over the mesh:
-  each device verifies its batch shard; outputs stay sharded (gathered
-  lazily by the host when read).
+* :func:`sharded_verify_fn` — ``shard_map`` of the kernel body over the
+  mesh: each device verifies its batch shard; outputs stay sharded
+  (gathered lazily by the host when read).
 * :class:`ShardedEd25519Verifier` — drop-in
   :class:`~consensus_tpu.models.ed25519.Ed25519BatchVerifier` that pads the
   batch to a multiple of the mesh size and runs the sharded kernel.
+
+Kernel construction rides an in-process ``(kernel, topology[, shape])`` ->
+compiled-fn memo (:func:`compiled_kernel`): rebuilding an engine — fleet
+restart, tenant churn, supervisor ladder reconstruction — reuses the
+already-traced jit wrapper instead of paying a retrace storm, which the obs
+kernel ledger's compile counter proves (tests/test_mesh.py).
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence, Union
 
 import numpy as np
 
@@ -38,9 +52,13 @@ from consensus_tpu.models.fused import (
     FusedEd25519BatchVerifier,
     FusedEd25519RandomizedBatchVerifier,
 )
-from consensus_tpu.obs.kernels import instrumented_jit
-
-BATCH_AXIS = "batch"
+from consensus_tpu.obs.kernels import COMPILE_CACHE, instrumented_jit
+from consensus_tpu.parallel.topology import (
+    BATCH_AXIS,
+    MeshTopology,
+    engine_padded_size,
+    mesh_padded_size,
+)
 
 # jax.shard_map was promoted to the top level after 0.4.x; older releases
 # ship it under jax.experimental only.
@@ -50,7 +68,9 @@ else:  # pragma: no cover - exercised on jax<0.5 installs
     from jax.experimental.shard_map import shard_map as _shard_map
 
 #: Device-layout partition specs: limb/bit arrays are (20|256, batch) —
-#: batch is the trailing axis; per-element vectors are (batch,).
+#: batch is the trailing axis; per-element vectors are (batch,).  These are
+#: the 1-D templates; :func:`_mesh_specs` widens the batch entry to the full
+#: axis-name tuple for N-D topologies.
 _IN_SPECS = (
     P(None, BATCH_AXIS),  # y_r
     P(BATCH_AXIS),        # sign_r
@@ -62,39 +82,91 @@ _IN_SPECS = (
 )
 
 
-def mesh_padded_size(n: int, n_shards: int, minimum: int = 8) -> int:
-    """Pow-2 growth for compile-shape reuse, then rounded UP to a multiple
-    of the mesh size — terminates for any shard count (a pure doubling loop
-    never exits for non-power-of-two meshes)."""
-    size = minimum
-    while size < n:
-        size *= 2
-    size += (-size) % n_shards
-    return size
+def _reduce_axes(mesh: Mesh):
+    """The axis-name argument collectives reduce/gather over: the bare
+    ``BATCH_AXIS`` on a 1-D mesh (bit-for-bit the historical graphs), the
+    full name tuple on N-D topologies."""
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
 
 
-def engine_padded_size(
-    n: int,
-    n_shards: int,
+def _mesh_specs(mesh: Mesh, specs):
+    """Widen 1-D spec templates to ``mesh``: every ``BATCH_AXIS`` entry
+    becomes the full axis-name tuple, so the batch dimension is sharded
+    across ALL mesh axes (row-major — matching tiled ``all_gather`` order
+    and the linear :func:`_shard_index`)."""
+    names = tuple(mesh.axis_names)
+    if names == (BATCH_AXIS,):
+        return tuple(specs)
+    return tuple(
+        P(*[names if part == BATCH_AXIS else part for part in spec])
+        for spec in specs
+    )
+
+
+def _shard_index(mesh: Mesh):
+    """This shard's linear index in global (row-major) lane order — inside a
+    shard body only.  Reduces to the historical ``axis_index(BATCH_AXIS)``
+    on 1-D meshes."""
+    names = tuple(mesh.axis_names)
+    idx = jax.lax.axis_index(names[0])
+    for name in names[1:]:
+        idx = idx * mesh.shape[name] + jax.lax.axis_index(name)
+    return idx
+
+
+# --- in-process compiled-kernel memo ----------------------------------------
+
+_COMPILED_KERNELS: dict = {}
+
+
+def _kernel_key(name: str, mesh: Mesh, extra: tuple) -> tuple:
+    return (
+        name,
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
+        tuple(int(d.id) for d in mesh.devices.flat),
+        extra,
+    )
+
+
+def compiled_kernel(
+    name: str,
+    mesh: Mesh,
+    builder: Callable[[], Callable],
     *,
-    pad_to: int = 0,
-    pad_pow2: bool = True,
-    minimum: int = 8,
-) -> int:
-    """Mesh-aligned padded batch size honouring the engine's padding knobs
-    (``pad_to`` pins one compiled shape, ``pad_pow2`` grows by doubling),
-    then rounded UP to a multiple of the mesh size so every shard gets an
-    equal slice."""
-    if pad_to >= n:
-        size = pad_to
-    elif pad_pow2:
-        size = minimum
-        while size < n:
-            size *= 2
+    memo: bool = True,
+    extra: tuple = (),
+) -> Callable:
+    """The in-process ``(kernel, topology[, shape])`` -> compiled-fn memo.
+
+    A jit wrapper's trace cache lives on the wrapper object, so an engine
+    that builds a fresh wrapper per construction re-traces every compiled
+    shape on rebuild even when XLA's persistent cache skips the backend
+    compile.  Two engines over the same mesh run the same computation, so
+    the wrapper itself is shared here instead — a rebuilt engine's warmup
+    books ZERO new compiles in the kernel ledger.  ``extra`` extends the key
+    for shape-specialized graphs (the fused aggregate's ``(n, padded)``).
+    Hits/misses book into :data:`consensus_tpu.obs.kernels.COMPILE_CACHE`;
+    ``memo=False`` (``CompileCacheConfig.enabled=False``) always builds
+    fresh and books a miss.
+    """
+    if not memo:
+        COMPILE_CACHE.record(hit=False)
+        return builder()
+    key = _kernel_key(name, mesh, extra)
+    fn = _COMPILED_KERNELS.get(key)
+    if fn is None:
+        COMPILE_CACHE.record(hit=False)
+        fn = _COMPILED_KERNELS[key] = builder()
     else:
-        size = max(n, 1)
-    size += (-size) % n_shards
-    return size
+        COMPILE_CACHE.record(hit=True)
+    return fn
+
+
+def clear_compiled_kernels() -> None:
+    """Drop every memoized kernel (tests; never needed in production)."""
+    _COMPILED_KERNELS.clear()
 
 
 def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -105,31 +177,84 @@ def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
 
 def mesh_for_shards(n_shards: int, devices: Optional[Sequence] = None) -> Mesh:
     """A 1-D mesh over the first ``n_shards`` visible devices — the
-    ``Configuration.mesh_shards`` -> engine seam.  Fails loudly when the
-    host exposes fewer devices than the config demands: silently shrinking
-    the mesh would make the one compiled kernel shape depend on deploy-time
+    ``Configuration.mesh_shards`` -> engine seam, now the 1-D special case
+    of :meth:`MeshTopology.build_mesh`.  Fails loudly when the host exposes
+    fewer devices than the config demands: silently shrinking the mesh
+    would make the one compiled kernel shape depend on deploy-time
     topology."""
-    devices = list(devices if devices is not None else jax.devices())
     if n_shards < 1:
         raise ValueError(f"mesh_shards must be >= 1, got {n_shards}")
-    if len(devices) < n_shards:
-        raise ValueError(
-            f"mesh_shards={n_shards} but only {len(devices)} device(s) "
-            "visible (set XLA_FLAGS=--xla_force_host_platform_device_count "
-            "for a host mesh, or lower mesh_shards)"
+    return MeshTopology((n_shards,)).build_mesh(devices)
+
+
+class _MeshEngine:
+    """Shared mesh plumbing for the sharded engines: topology coercion, the
+    memoized kernel seam, and the wave-sizing surface the coalescers read.
+
+    ``mesh`` may be a ``jax.sharding.Mesh`` or a :class:`MeshTopology`
+    (built over the visible devices); ``compile_cache=False`` opts this
+    engine out of the process-wide compiled-kernel memo."""
+
+    def _init_mesh(
+        self,
+        mesh: Union[Mesh, MeshTopology, None],
+        kernel_name: str,
+        builder: Callable[[Mesh], Callable],
+        in_specs,
+        compile_cache: bool = True,
+    ) -> None:
+        if isinstance(mesh, MeshTopology):
+            mesh = mesh.build_mesh()
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self._compile_cache = bool(compile_cache)
+        self._in_specs = _mesh_specs(self.mesh, in_specs)
+        self._fn = compiled_kernel(
+            kernel_name,
+            self.mesh,
+            lambda: builder(self.mesh),
+            memo=self._compile_cache,
         )
-    return Mesh(np.array(devices[:n_shards]), (BATCH_AXIS,))
+        self._n_shards = int(self.mesh.devices.size)
+
+    @property
+    def shard_count(self) -> int:
+        """Devices this engine spreads a batch across.  The engine
+        supervisor's degrade ladder labels mesh rungs with it (an
+        ``N-shard`` rung degrading to a ``1-shard`` rung reads as exactly
+        that in logs/traces rather than two identical class names)."""
+        return self._n_shards
+
+    @property
+    def preferred_wave_size(self) -> int:
+        """The smallest padded wave that saturates the whole topology —
+        every shard receives at least ``min_device_batch`` lanes, rounded
+        through the engine's padding knobs.  The wave formers
+        (models/engine.py) flush early once this many signatures are
+        aboard: waiting longer adds latency without adding devices."""
+        return engine_padded_size(
+            self._n_shards * max(1, self._min_device_batch),
+            self._n_shards,
+            pad_to=self._pad_to,
+            pad_pow2=self._pad_pow2,
+        )
+
+    def _put_sharded(self, device_args):
+        return [
+            jax.device_put(np.asarray(a), NamedSharding(self.mesh, spec))
+            for a, spec in zip(device_args, self._in_specs)
+        ]
 
 
 def sharded_verify_fn(mesh: Mesh):
     """A jitted verify over ``mesh``: inputs sharded on the batch axis, plus
     a ``psum``-reduced valid count so the collective path is exercised."""
+    axes = _reduce_axes(mesh)
 
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=_IN_SPECS,
-        out_specs=(P(BATCH_AXIS), P()),
+        in_specs=_mesh_specs(mesh, _IN_SPECS),
+        out_specs=_mesh_specs(mesh, (P(BATCH_AXIS), P())),
     )
     def _shard(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok):
         from consensus_tpu.models.ed25519 import suppress_pallas_scan
@@ -139,28 +264,27 @@ def sharded_verify_fn(mesh: Mesh):
         # always traces the XLA scan, opt-in flag or not.
         with suppress_pallas_scan():
             ok = verify_impl(y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok)
-        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
+        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axes)
         return ok, total
 
     return instrumented_jit(_shard, "ed25519.sharded_verify")
 
 
-class ShardedEd25519Verifier(Ed25519BatchVerifier):
+class ShardedEd25519Verifier(_MeshEngine, Ed25519BatchVerifier):
     """Batch verifier that spreads the batch across a device mesh."""
 
-    def __init__(self, mesh: Optional[Mesh] = None, **kw) -> None:
+    def __init__(
+        self,
+        mesh: Union[Mesh, MeshTopology, None] = None,
+        *,
+        compile_cache: bool = True,
+        **kw,
+    ) -> None:
         super().__init__(**kw)
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self._fn = sharded_verify_fn(self.mesh)
-        self._n_shards = self.mesh.devices.size
-
-    @property
-    def shard_count(self) -> int:
-        """Devices this engine spreads a batch across.  The engine
-        supervisor's degrade ladder labels mesh rungs with it (an
-        ``N-shard`` rung degrading to a ``1-shard`` rung reads as exactly
-        that in logs/traces rather than two identical class names)."""
-        return self._n_shards
+        self._init_mesh(
+            mesh, "ed25519.sharded_verify", sharded_verify_fn, _IN_SPECS,
+            compile_cache,
+        )
 
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         n = len(messages)
@@ -189,11 +313,7 @@ class ShardedEd25519Verifier(Ed25519BatchVerifier):
         device_args = to_kernel_layout(
             y_r, sign_r, y_a, sign_a, s_bits, k_bits, host_ok
         )
-        args = [
-            jax.device_put(a, NamedSharding(self.mesh, spec))
-            for a, spec in zip(device_args, _IN_SPECS)
-        ]
-        ok, _total = self._fn(*args)
+        ok, _total = self._fn(*self._put_sharded(device_args))
         return np.asarray(ok)[:n]
 
 
@@ -217,11 +337,13 @@ def sharded_p256_verify_fn(mesh: Mesh):
     """jitted ECDSA-P256 verify over ``mesh`` with a psum valid count."""
     from consensus_tpu.models.ecdsa_p256 import verify_impl as p256_verify_impl
 
+    axes = _reduce_axes(mesh)
+
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=_P256_IN_SPECS,
-        out_specs=(P(BATCH_AXIS), P()),
+        in_specs=_mesh_specs(mesh, _P256_IN_SPECS),
+        out_specs=_mesh_specs(mesh, (P(BATCH_AXIS), P())),
     )
     def _shard(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok):
         from consensus_tpu.ops.pallas_scan import suppress_pallas_scan
@@ -229,26 +351,28 @@ def sharded_p256_verify_fn(mesh: Mesh):
         # Same rule as the Ed25519 shard: no pallas_call under shard_map.
         with suppress_pallas_scan():
             ok = p256_verify_impl(qx, qy, u1d, u2d, r1, r2, has_r2, host_ok)
-        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
+        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axes)
         return ok, total
 
     return instrumented_jit(_shard, "ecdsa_p256.sharded_verify")
 
 
-class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):
+class ShardedEcdsaP256Verifier(_MeshEngine, EcdsaP256BatchVerifier):
     """ECDSA-P256 batch verifier spread across a device mesh (reuses the
     base class's preparation/validation; only the launch path differs)."""
 
-    def __init__(self, mesh: Optional[Mesh] = None, **kw) -> None:
+    def __init__(
+        self,
+        mesh: Union[Mesh, MeshTopology, None] = None,
+        *,
+        compile_cache: bool = True,
+        **kw,
+    ) -> None:
         super().__init__(**kw)
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self._fn = sharded_p256_verify_fn(self.mesh)
-        self._n_shards = self.mesh.devices.size
-
-    @property
-    def shard_count(self) -> int:
-        """Devices this engine spreads a batch across (ladder labeling)."""
-        return self._n_shards
+        self._init_mesh(
+            mesh, "ecdsa_p256.sharded_verify", sharded_p256_verify_fn,
+            _P256_IN_SPECS, compile_cache,
+        )
 
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         from consensus_tpu.models.ecdsa_p256 import pad_prepared, to_kernel_layout
@@ -265,11 +389,7 @@ class ShardedEcdsaP256Verifier(EcdsaP256BatchVerifier):
             n, self._n_shards, pad_to=self._pad_to, pad_pow2=self._pad_pow2
         )
         device_args = to_kernel_layout(*pad_prepared(prepped, padded))
-        args = [
-            jax.device_put(a, NamedSharding(self.mesh, spec))
-            for a, spec in zip(device_args, _P256_IN_SPECS)
-        ]
-        ok, _total = self._fn(*args)
+        ok, _total = self._fn(*self._put_sharded(device_args))
         return np.asarray(ok)[:n]
 
 
@@ -305,11 +425,13 @@ def sharded_batch_verify_fn(mesh: Mesh):
     """
     from consensus_tpu.models.ed25519 import batch_verify_impl
 
+    axes = _reduce_axes(mesh)
+
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=_RAND_IN_SPECS,
-        out_specs=(P(), P(BATCH_AXIS)),
+        in_specs=_mesh_specs(mesh, _RAND_IN_SPECS),
+        out_specs=_mesh_specs(mesh, (P(), P(BATCH_AXIS))),
     )
     def _shard(y_r, sign_r, y_a, sign_a, zs_digits8, zk_digits, z_digits, host_ok):
         from consensus_tpu.models.ed25519 import suppress_pallas_scan
@@ -319,13 +441,13 @@ def sharded_batch_verify_fn(mesh: Mesh):
             eq_ok, valid = batch_verify_impl(
                 y_r, sign_r, y_a, sign_a, zs_digits8, zk_digits, z_digits, host_ok
             )
-        bad = jax.lax.psum(1 - eq_ok.astype(jnp.int32), BATCH_AXIS)
+        bad = jax.lax.psum(1 - eq_ok.astype(jnp.int32), axes)
         return bad == 0, valid
 
     return instrumented_jit(_shard, "ed25519.sharded_batch_verify")
 
 
-class ShardedEd25519RandomizedVerifier(Ed25519RandomizedBatchVerifier):
+class ShardedEd25519RandomizedVerifier(_MeshEngine, Ed25519RandomizedBatchVerifier):
     """Randomized batch verifier whose aggregate check rides the mesh.
 
     Only the device aggregate changes: the bisection driver, transcript
@@ -334,16 +456,18 @@ class ShardedEd25519RandomizedVerifier(Ed25519RandomizedBatchVerifier):
     caveat) are exactly the single-device engine's.
     """
 
-    def __init__(self, mesh: Optional[Mesh] = None, **kw) -> None:
+    def __init__(
+        self,
+        mesh: Union[Mesh, MeshTopology, None] = None,
+        *,
+        compile_cache: bool = True,
+        **kw,
+    ) -> None:
         super().__init__(**kw)
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self._fn = sharded_batch_verify_fn(self.mesh)
-        self._n_shards = self.mesh.devices.size
-
-    @property
-    def shard_count(self) -> int:
-        """Devices this engine spreads a batch across (ladder labeling)."""
-        return self._n_shards
+        self._init_mesh(
+            mesh, "ed25519.sharded_batch_verify", sharded_batch_verify_fn,
+            _RAND_IN_SPECS, compile_cache,
+        )
 
     def _aggregate_device(self, idx, signatures, public_keys, scalars, zs):
         from consensus_tpu.models.ed25519 import (
@@ -385,7 +509,9 @@ class ShardedEd25519RandomizedVerifier(Ed25519RandomizedBatchVerifier):
 
         # Per-shard fixed-base scalars: lane j lives on shard j // per, so
         # u_s sums z·s over exactly that shard's live lanes.  Pad-only
-        # shards get u_s = 0 (identity comb contribution).
+        # shards get u_s = 0 (identity comb contribution).  Shard order is
+        # the linear row-major device order on every topology, so the same
+        # slicing covers 1-D and N-D meshes.
         per = padded // self._n_shards
         u_rows = np.zeros((self._n_shards, 32), dtype=np.uint8)
         for s in range(self._n_shards):
@@ -407,11 +533,7 @@ class ShardedEd25519RandomizedVerifier(Ed25519RandomizedBatchVerifier):
             z_digits,
             host_ok,
         )
-        args = [
-            jax.device_put(np.asarray(a), NamedSharding(self.mesh, spec))
-            for a, spec in zip(device_args, _RAND_IN_SPECS)
-        ]
-        eq_ok, valid = self._fn(*args)
+        eq_ok, valid = self._fn(*self._put_sharded(device_args))
         return bool(np.asarray(eq_ok)), list(np.asarray(valid)[:m])
 
 
@@ -436,11 +558,13 @@ def sharded_fused_verify_fn(mesh: Mesh):
     at the validity-count edge."""
     from consensus_tpu.models.fused import fused_verify_impl
 
+    axes = _reduce_axes(mesh)
+
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=_FUSED_IN_SPECS,
-        out_specs=(P(BATCH_AXIS), P()),
+        in_specs=_mesh_specs(mesh, _FUSED_IN_SPECS),
+        out_specs=_mesh_specs(mesh, (P(BATCH_AXIS), P())),
     )
     def _shard(sig_rows, key_rows, blocks, n_blocks, host_ok):
         from consensus_tpu.models.ed25519 import suppress_pallas_scan
@@ -448,22 +572,29 @@ def sharded_fused_verify_fn(mesh: Mesh):
         # Same rule as the host-prep shards: no pallas_call under shard_map.
         with suppress_pallas_scan():
             ok = fused_verify_impl(sig_rows, key_rows, blocks, n_blocks, host_ok)
-        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
+        total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axes)
         return ok, total
 
     return instrumented_jit(_shard, "ed25519.sharded_fused_verify")
 
 
-class ShardedFusedEd25519Verifier(FusedEd25519BatchVerifier):
+class ShardedFusedEd25519Verifier(_MeshEngine, FusedEd25519BatchVerifier):
     """Fused strict verifier that spreads the batch across a device mesh —
-    ``Configuration.device_prep`` + ``mesh_shards > 1``.  Verdicts are
+    ``Configuration.device_prep`` + a multi-device topology.  Verdicts are
     bit-identical to every other strict engine."""
 
-    def __init__(self, mesh: Optional[Mesh] = None, **kw) -> None:
+    def __init__(
+        self,
+        mesh: Union[Mesh, MeshTopology, None] = None,
+        *,
+        compile_cache: bool = True,
+        **kw,
+    ) -> None:
         super().__init__(**kw)
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self._fn = sharded_fused_verify_fn(self.mesh)
-        self._n_shards = self.mesh.devices.size
+        self._init_mesh(
+            mesh, "ed25519.sharded_fused_verify", sharded_fused_verify_fn,
+            _FUSED_IN_SPECS, compile_cache,
+        )
 
     def verify_batch(self, messages, signatures, public_keys) -> np.ndarray:
         from consensus_tpu.models.fused import _pad_wave
@@ -493,11 +624,7 @@ class ShardedFusedEd25519Verifier(FusedEd25519BatchVerifier):
             n_blocks,
             host_ok,
         )
-        args = [
-            jax.device_put(np.asarray(a), NamedSharding(self.mesh, spec))
-            for a, spec in zip(device_args, _FUSED_IN_SPECS)
-        ]
-        ok, _total = self._fn(*args)
+        ok, _total = self._fn(*self._put_sharded(device_args))
         return np.asarray(ok)[:n]
 
 
@@ -525,6 +652,9 @@ def sharded_fused_aggregate_fn(mesh: Mesh, tag: bytes, n: int, padded: int):
     digest table on every shard, and each shard then derives the IDENTICAL
     root and its own lanes' coefficients ``zᵢ = H(root ‖ i)`` — the same
     transcript bytes as the host twin, so coefficients match bit-for-bit.
+    (On an N-D topology the gather runs over the full axis tuple in
+    row-major order — the same global lane order the input sharding uses,
+    so the assembled table is identical to the 1-D mesh's.)
     As in :func:`sharded_batch_verify_fn`, every shard checks an
     independent aggregate over its lane subset with its own base scalar
     ``u_s = Σ zᵢsᵢ`` (pad lanes carry s = 0 and masked digits, so a
@@ -544,6 +674,7 @@ def sharded_fused_aggregate_fn(mesh: Mesh, tag: bytes, n: int, padded: int):
     if padded % n_shards:
         raise ValueError("padded batch must be a multiple of the mesh size")
     per = padded // n_shards
+    axes = _reduce_axes(mesh)
     (
         root_prefix, root_trailer, root_blocks, z_trailer, idx_rows
     ) = _aggregate_constants(tag, n, padded)
@@ -553,8 +684,8 @@ def sharded_fused_aggregate_fn(mesh: Mesh, tag: bytes, n: int, padded: int):
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=_FUSED_AGG_IN_SPECS,
-        out_specs=(P(), P(BATCH_AXIS)),
+        in_specs=_mesh_specs(mesh, _FUSED_AGG_IN_SPECS),
+        out_specs=_mesh_specs(mesh, (P(), P(BATCH_AXIS))),
     )
     def _shard(
         r_rows, s_rows, key_rows, k_blocks, k_nblocks,
@@ -562,7 +693,7 @@ def sharded_fused_aggregate_fn(mesh: Mesh, tag: bytes, n: int, padded: int):
     ):
         from consensus_tpu.models.ed25519 import suppress_pallas_scan
 
-        shard = jax.lax.axis_index(BATCH_AXIS)
+        shard = _shard_index(mesh)
         r = r_rows.astype(jnp.int32)
         key = key_rows.astype(jnp.int32)
         with suppress_pallas_scan():
@@ -573,7 +704,7 @@ def sharded_fused_aggregate_fn(mesh: Mesh, tag: bytes, n: int, padded: int):
                 sh.sha512_blocks(leaf_blocks, leaf_nblocks)
             )  # (64, per)
             gathered = jax.lax.all_gather(
-                leaves, BATCH_AXIS, axis=1, tiled=True
+                leaves, axes, axis=1, tiled=True
             )  # (64, padded), global lane order
             root_rows = jnp.concatenate(
                 [
@@ -620,7 +751,7 @@ def sharded_fused_aggregate_fn(mesh: Mesh, tag: bytes, n: int, padded: int):
                 y_r, r[31] >> 7, y_a, key[31] >> 7, u, zk_digits, z_digits,
                 host_ok,
             )
-        bad = jax.lax.psum(1 - eq_ok.astype(jnp.int32), BATCH_AXIS)
+        bad = jax.lax.psum(1 - eq_ok.astype(jnp.int32), axes)
         return bad == 0, valid
 
     return instrumented_jit(_shard, "ed25519.sharded_fused_batch_verify")
@@ -634,12 +765,20 @@ class ShardedFusedEd25519RandomizedVerifier(
     pre-filter are inherited from the single-device fused engine; only the
     two launch seams are re-routed."""
 
-    def __init__(self, mesh: Optional[Mesh] = None, **kw) -> None:
+    def __init__(
+        self,
+        mesh: Union[Mesh, MeshTopology, None] = None,
+        *,
+        compile_cache: bool = True,
+        **kw,
+    ) -> None:
         # The randomized base consumes min_randomized before the strict
         # chain; with the diamond MRO here the strict chain would skip it,
         # so pop + set it explicitly (same clamp as the base).
         min_randomized = kw.pop("min_randomized", 2)
-        ShardedFusedEd25519Verifier.__init__(self, mesh, **kw)
+        ShardedFusedEd25519Verifier.__init__(
+            self, mesh, compile_cache=compile_cache, **kw
+        )
         self._min_randomized = max(2, int(min_randomized))
         self._agg_fns: dict = {}
 
@@ -687,10 +826,16 @@ class ShardedFusedEd25519RandomizedVerifier(
             k_blocks = np.pad(k_blocks, batch_pad)
             leaf_blocks = np.pad(leaf_blocks, batch_pad)
 
+        # Instance memo first (the historical per-engine shape cache), then
+        # the process-wide memo so a REBUILT engine reuses the traced graph.
         fn = self._agg_fns.get((m, padded))
         if fn is None:
-            fn = self._agg_fns[(m, padded)] = sharded_fused_aggregate_fn(
-                self.mesh, _Z_TAG, m, padded
+            fn = self._agg_fns[(m, padded)] = compiled_kernel(
+                "ed25519.sharded_fused_batch_verify",
+                self.mesh,
+                lambda: sharded_fused_aggregate_fn(self.mesh, _Z_TAG, m, padded),
+                memo=self._compile_cache,
+                extra=(_Z_TAG, m, padded),
             )
         device_args = (
             np.ascontiguousarray(r_rows.T),
@@ -704,7 +849,7 @@ class ShardedFusedEd25519RandomizedVerifier(
         )
         args = [
             jax.device_put(np.asarray(a), NamedSharding(self.mesh, spec))
-            for a, spec in zip(device_args, _FUSED_AGG_IN_SPECS)
+            for a, spec in zip(device_args, _mesh_specs(self.mesh, _FUSED_AGG_IN_SPECS))
         ]
         eq_ok, valid = fn(*args)
         return bool(np.asarray(eq_ok)), list(np.asarray(valid)[:m])
@@ -713,6 +858,8 @@ class ShardedFusedEd25519RandomizedVerifier(
 __all__ = [
     "make_mesh",
     "mesh_for_shards",
+    "compiled_kernel",
+    "clear_compiled_kernels",
     "sharded_verify_fn",
     "sharded_batch_verify_fn",
     "sharded_p256_verify_fn",
@@ -723,6 +870,7 @@ __all__ = [
     "ShardedEcdsaP256Verifier",
     "ShardedFusedEd25519Verifier",
     "ShardedFusedEd25519RandomizedVerifier",
+    "MeshTopology",
     "mesh_padded_size",
     "engine_padded_size",
     "BATCH_AXIS",

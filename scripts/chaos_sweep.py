@@ -52,6 +52,17 @@ Without it, schedules are byte-identical to pre-storage-fault sweeps.
 
     python scripts/chaos_sweep.py --start 0 --count 50 --storage-faults
 
+``--mesh-shards N`` / ``--topology AxB`` route every seed's real Ed25519
+verification through the sharded mesh engines (consensus_tpu/parallel/):
+the sweep builds the engine once via ``engine_for_config`` over the
+requested device layout (virtual CPU devices are fabricated when running
+standalone) and every replica shares it, so mesh engines run under the
+full chaos vocabulary.  Implies ``crypto="ed25519"``; incompatible with
+``--cert-mode half-agg`` (the half-agg path owns its own engine).
+
+    python scripts/chaos_sweep.py --start 0 --count 20 --mesh-shards 2
+    python scripts/chaos_sweep.py --start 0 --count 20 --topology 2x4
+
 Every seed runs with the observability plane sampling (read-only: ledgers
 and verdicts are identical to an unsampled run) and emits one per-seed JSON
 line with its anomaly-detector counts and the final health snapshot of
@@ -86,9 +97,44 @@ from consensus_tpu.testing.chaos import (  # noqa: E402
 )
 
 
+def _mesh_engine_factory(args):
+    """(zero-arg engine factory, topology label) for the sweep's
+    ``--mesh-shards`` / ``--topology`` request.  Fabricates virtual CPU
+    devices before jax initialises (same guard as
+    ``__graft_entry__.dryrun_multichip``) so the tool works standalone."""
+    import os
+
+    from consensus_tpu.parallel.topology import MeshTopology
+
+    topo = MeshTopology.normalize(args.topology or args.mesh_shards)
+    if args.mesh_shards and topo.shard_count != args.mesh_shards:
+        raise SystemExit(
+            f"--mesh-shards {args.mesh_shards} does not match --topology "
+            f"{topo.label} ({topo.shard_count} devices)"
+        )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={topo.shard_count}"
+        ).strip()
+
+    from consensus_tpu.config import Configuration
+    from consensus_tpu.models.verifier import engine_for_config
+
+    cfg = Configuration().with_(
+        mesh_shards=topo.shard_count, mesh_topology=topo.axes
+    )
+    return (lambda: engine_for_config(cfg)), topo.label
+
+
 def run_sweep(args) -> int:
     failed: list[int] = []
     anomaly_totals: dict[str, int] = {}
+    engine_factory, mesh_label = None, ""
+    if args.mesh_shards or args.topology:
+        engine_factory, mesh_label = _mesh_engine_factory(args)
     obs = ObsConfig(enabled=True, sample_interval=args.sample_interval)
     for seed in range(args.start, args.start + args.count):
         schedule = ChaosSchedule.generate(
@@ -102,7 +148,11 @@ def run_sweep(args) -> int:
         # trivial-crypto sweep.  (A device-fault schedule promotes itself
         # to "ed25519" inside the engine when crypto is unset.)
         crypto = "ed25519-halfagg" if args.cert_mode == "half-agg" else None
-        engine = ChaosEngine(schedule, obs=obs, crypto=crypto)
+        if engine_factory is not None:
+            crypto = "ed25519"  # engine_factory requires a crypto mode
+        engine = ChaosEngine(
+            schedule, obs=obs, crypto=crypto, engine_factory=engine_factory
+        )
         result = engine.run()
         counts: dict[str, int] = {}
         for a in result.anomalies:
@@ -167,6 +217,8 @@ def run_sweep(args) -> int:
             "device_faults": args.device_faults,
             "storage_faults": args.storage_faults,
             "cert_mode": args.cert_mode,
+            "mesh_shards": args.mesh_shards,
+            "topology": mesh_label,
         },
     }
     line = json.dumps(summary, sort_keys=True)
@@ -214,6 +266,15 @@ def main() -> int:
                          "under real Ed25519 with half-aggregated certs "
                          '(Configuration.cert_mode); "full" is the '
                          "seed-identical default")
+    ap.add_argument("--mesh-shards", type=int, default=0,
+                    help="run every seed's Ed25519 verification through "
+                         "the 1-D sharded mesh engine over N devices "
+                         "(implies real crypto; virtual CPU devices are "
+                         "fabricated when running standalone)")
+    ap.add_argument("--topology", default="",
+                    help='device layout for the mesh engine, e.g. "8" or '
+                         '"2x4" (named 2-D mesh axes); combines with '
+                         "--mesh-shards only when the device counts agree")
     ap.add_argument("--sample-interval", type=float, default=5.0,
                     help="obs-plane sampling interval (sim seconds)")
     ap.add_argument("--shrink-on-failure", action="store_true",
@@ -223,7 +284,12 @@ def main() -> int:
     ap.add_argument("--json-out", help="also write the summary line here")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print passing seeds too")
-    return run_sweep(ap.parse_args())
+    args = ap.parse_args()
+    if (args.mesh_shards or args.topology) and args.cert_mode == "half-agg":
+        ap.error("--mesh-shards/--topology run plain Ed25519 batch "
+                 "verification and cannot be combined with "
+                 "--cert-mode half-agg")
+    return run_sweep(args)
 
 
 if __name__ == "__main__":

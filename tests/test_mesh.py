@@ -14,7 +14,15 @@ devices — no accelerator required.  The gates:
   ledgers and event logs vs the default engine construction;
 * the randomized mesh lane (per-shard aggregate checks, verdict reduced
   with one psum) matches ground truth — slow-marked, its first compile on
-  a host mesh runs minutes.
+  a host mesh runs minutes;
+* 2-D named topologies (``mesh_topology=(2, 4)``) are exactly parity with
+  the single-device engine on all 8 devices — geometry, like shard count,
+  never changes verdicts;
+* the engine registry resolves every advertised key and fails loud (with
+  the curve-specific reason) on every unregistered cell;
+* rebuilding an engine over the same topology books ZERO new compiles in
+  the kernel ledger with the compile cache on, and >= 1 with it off — the
+  retrace-storm regression gate.
 """
 
 import dataclasses
@@ -194,3 +202,160 @@ def test_chaos_engine_factory_requires_crypto_mode():
             ChaosSchedule(seed=1, n=4, actions=()),
             engine_factory=lambda: Ed25519BatchVerifier(),
         )
+
+
+# --- topologies: parse/normalize sugar and 2-D meshes ------------------------
+
+
+def test_topology_normalize_parse_and_sugar():
+    from consensus_tpu.parallel import MeshTopology
+
+    assert MeshTopology.parse("2x4").axes == (2, 4)
+    assert MeshTopology.parse("8").axes == (8,)
+    # mesh_shards=N is sugar for the 1-D topology (N,)
+    assert MeshTopology.normalize(8) == MeshTopology((8,))
+    assert MeshTopology.normalize(None) == MeshTopology((1,))
+    assert MeshTopology.normalize("2x2").shard_count == 4
+    assert MeshTopology((2, 4)).label == "2x4"
+    assert MeshTopology((8,)).label == "8"
+    with pytest.raises(ValueError, match="cannot parse topology"):
+        MeshTopology.parse("2xbatch")
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        MeshTopology((4, 4)).build_mesh()
+
+
+def test_2d_topology_strict_parity_on_2x4_host_mesh():
+    """A (2, 4) named 2-D mesh — tuple-of-axis batch sharding, psum over
+    both axes — must return the EXACT verdict array of the single-device
+    engine, same gate as the 1-D 8-way mesh above."""
+    cfg = dataclasses.replace(
+        Configuration(), mesh_topology=(2, 4), crypto_tpu_min_batch=1
+    )
+    eng = engine_for_config(cfg)
+    assert isinstance(eng, ShardedEd25519Verifier)
+    assert eng.mesh.devices.shape == (2, 4)
+    assert eng.shard_count == 8
+    msgs, sigs, keys = make_sigs(13, corrupt=(3, 9))
+    sharded = np.asarray(eng.verify_batch(msgs, sigs, keys))
+    single = np.asarray(
+        Ed25519BatchVerifier(min_device_batch=1).verify_batch(msgs, sigs, keys)
+    )
+    assert (sharded == single).all()
+    assert list(np.flatnonzero(~sharded)) == [3, 9]
+
+
+def test_config_validates_mesh_topology_and_compile_cache():
+    from consensus_tpu.config import CompileCacheConfig
+
+    Configuration(self_id=1, mesh_shards=8, mesh_topology=(2, 4)).validate()
+    with pytest.raises(ValueError, match="axes product must equal"):
+        Configuration(self_id=1, mesh_shards=4, mesh_topology=(2, 4)).validate()
+    with pytest.raises(ValueError, match="axes must all be >= 1"):
+        Configuration(self_id=1, mesh_topology=(2, 0)).validate()
+    with pytest.raises(ValueError, match="min_compile_time_secs"):
+        Configuration(
+            self_id=1,
+            compile_cache=CompileCacheConfig(min_compile_time_secs=-1.0),
+        ).validate()
+
+
+# --- engine registry: every advertised key resolves or fails loud ------------
+
+
+def test_engine_registry_completeness_and_loud_failures():
+    from consensus_tpu.models.registry import (
+        ENGINE_REGISTRY,
+        MODES,
+        TOPOLOGIES,
+        EngineKey,
+        UnknownEngineError,
+    )
+
+    for key in ENGINE_REGISTRY.keys():
+        assert key in ENGINE_REGISTRY
+        assert callable(ENGINE_REGISTRY.builder(key))
+    # Every cell of the advertised matrix is either registered or refuses
+    # with the curve-specific reason (the Ed25519-only lanes).
+    for curve in ENGINE_REGISTRY.curves():
+        for mode in MODES:
+            for topo in TOPOLOGIES:
+                for prep in (False, True):
+                    key = EngineKey(curve, mode, topo, prep)
+                    if key in ENGINE_REGISTRY:
+                        continue
+                    with pytest.raises(UnknownEngineError) as exc:
+                        ENGINE_REGISTRY.builder(key)
+                    assert "Ed25519-only" in str(exc.value)
+    with pytest.raises(UnknownEngineError, match="unknown curve"):
+        ENGINE_REGISTRY.builder(EngineKey(curve="ed448"))
+    with pytest.raises(ValueError, match="already registered"):
+        ENGINE_REGISTRY.register(
+            EngineKey(), lambda topology, compile_cache, **kw: None
+        )
+
+
+# --- compile cache: rebuilds book zero new compiles --------------------------
+
+
+def test_engine_rebuild_books_zero_new_compiles_with_cache_on():
+    """The retrace-storm regression gate: rebuilding the same sharded
+    engine over the same topology (restart, degrade ladder, tenant churn)
+    reuses the process-wide compiled-kernel memo, so the kernel ledger
+    books ZERO new compiles on the second warmup.  With the cache disabled
+    the rebuild re-traces (>= 1 new compile), proving the counter is
+    live, not just flat."""
+    from consensus_tpu.config import CompileCacheConfig
+    from consensus_tpu.obs.kernels import COMPILE_CACHE, KERNELS
+    from consensus_tpu.parallel.sharding import clear_compiled_kernels
+
+    clear_compiled_kernels()
+    cfg = dataclasses.replace(
+        Configuration(), mesh_shards=8, crypto_tpu_min_batch=1
+    )
+    msgs, sigs, keys = make_sigs(8)
+
+    engine_for_config(cfg).verify_batch(msgs, sigs, keys)
+    booked = KERNELS.stats("ed25519.sharded_verify").compiles
+    hits0 = COMPILE_CACHE.snapshot()["hits"]
+
+    engine_for_config(cfg).verify_batch(msgs, sigs, keys)
+    assert KERNELS.stats("ed25519.sharded_verify").compiles == booked
+    assert COMPILE_CACHE.snapshot()["hits"] == hits0 + 1
+
+    off = dataclasses.replace(
+        cfg, compile_cache=CompileCacheConfig(enabled=False)
+    )
+    engine_for_config(off).verify_batch(msgs, sigs, keys)
+    assert KERNELS.stats("ed25519.sharded_verify").compiles > booked
+
+
+# --- slice-filling wave formation --------------------------------------------
+
+
+def test_slice_wave_target_fills_whole_slices():
+    from consensus_tpu.models.engine import _slice_wave_target
+
+    class MeshEngine:
+        shard_count = 4
+        preferred_wave_size = 32
+
+    class NoPreference:
+        shard_count = 4
+        preferred_wave_size = 0
+
+    assert _slice_wave_target(MeshEngine(), 256) == 32
+    assert _slice_wave_target(MeshEngine(), 16) == 16  # cap still wins
+    assert _slice_wave_target(NoPreference(), 256) == 256
+    # single-device engines keep the configured cap bit-for-bit
+    assert _slice_wave_target(Ed25519BatchVerifier(), 256) == 256
+
+
+def test_preferred_wave_size_is_a_whole_slice_multiple():
+    eng = engine_for_config(
+        dataclasses.replace(
+            Configuration(), mesh_shards=8, crypto_tpu_min_batch=1
+        )
+    )
+    assert eng.preferred_wave_size % eng.shard_count == 0
+    assert eng.preferred_wave_size >= eng.shard_count
+    assert Ed25519BatchVerifier(min_device_batch=5).preferred_wave_size == 8

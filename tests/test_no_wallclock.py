@@ -141,6 +141,26 @@ def test_lint_covers_models_aggregate():
     )
 
 
+def test_lint_covers_parallel_plane():
+    """The parallel plane (topology specs, sharded engines, the
+    compiled-kernel memo) feeds the same verdict path as the single-device
+    engines — SAFETY.md §7's "topology never changes verdicts" holds only
+    if nothing in the tree reads real time into a traced graph or a memo
+    key.  Pin the lint's coverage of consensus_tpu/parallel/, presence of
+    the expected modules first."""
+    parallel_dir = os.path.join(_REPO, "consensus_tpu", "parallel")
+    present = {f for f in os.listdir(parallel_dir) if f.endswith(".py")}
+    assert {"sharding.py", "topology.py"} <= present
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, parallel_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        "parallel plane has wall-clock reads:\n" + proc.stdout + proc.stderr
+    )
+
+
 def test_lint_covers_storage_fault_layer():
     """The storage-fault injector (testing/storage.py) and the WAL scrubber
     (wal/scrub.py) both promise seed-deterministic, injected-clock-only
