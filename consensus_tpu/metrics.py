@@ -37,6 +37,21 @@ NET_INJECTED_KEYS = (
     NET_DROPPED_KEY, NET_DUPLICATED_KEY, NET_REORDERED_KEY, NET_REPLAYED_KEY,
 )
 
+#: Pinned instrument names for the real TCP transport's reconnect path
+#: (consensus_tpu/net/transport.py).  The Comm contract stays
+#: fire-and-forget, but connection-refused and mid-frame abrupt-close now
+#: get bounded retry with backoff + jitter before a frame is dropped —
+#: these counters make that recovery visible per process so the deploy
+#: rig's soak scraper can attribute chaos-induced churn.
+NET_RECONNECT_ATTEMPTS_KEY = "net_reconnect_attempts"
+NET_RECONNECT_SUCCESS_KEY = "net_reconnect_success"
+NET_SEND_RETRIED_KEY = "net_send_retried"
+NET_SEND_DROPPED_KEY = "net_send_dropped"
+NET_RECONNECT_KEYS = (
+    NET_RECONNECT_ATTEMPTS_KEY, NET_RECONNECT_SUCCESS_KEY,
+    NET_SEND_RETRIED_KEY, NET_SEND_DROPPED_KEY,
+)
+
 #: Pinned instrument names for the observability plane (consensus_tpu/obs/).
 #: One counter per anomaly detector — the sampler bumps the affected node's
 #: counter the moment a detector fires (edge-triggered), mirrored by an
@@ -210,6 +225,16 @@ PINNED_METRIC_KEYS: dict[str, str] = {
     NET_DUPLICATED_KEY: "messages delivered twice by network injection",
     NET_REORDERED_KEY: "messages held back past later sends",
     NET_REPLAYED_KEY: "stale captured messages re-delivered",
+    NET_RECONNECT_ATTEMPTS_KEY:
+        "TCP peer (re)connect attempts (refused/reset peers retried with "
+        "backoff + jitter)",
+    NET_RECONNECT_SUCCESS_KEY:
+        "TCP peer (re)connects that completed the HELLO handshake",
+    NET_SEND_RETRIED_KEY:
+        "frames re-sent after a mid-frame abrupt close (peer killed)",
+    NET_SEND_DROPPED_KEY:
+        "frames dropped after exhausting connect/send retries "
+        "(fire-and-forget contract)",
     OBS_SAMPLES_KEY: "observability-plane samples taken",
     OBS_ANOMALY_COMMIT_STALL_KEY:
         "detector firings: pending work but no ledger growth",
@@ -777,6 +802,28 @@ class MetricsNetwork(_Bundle):
         self.count_replayed = p.new_counter(
             NET_REPLAYED_KEY, "Stale captured messages re-delivered.", ln
         )
+        # Real-transport reconnect path (net/transport.py): a TcpComm with
+        # this bundle attached books every bounded-retry outcome here.
+        self.count_reconnect_attempts = p.new_counter(
+            NET_RECONNECT_ATTEMPTS_KEY,
+            "TCP peer (re)connect attempts, including retries.",
+            ln,
+        )
+        self.count_reconnect_success = p.new_counter(
+            NET_RECONNECT_SUCCESS_KEY,
+            "TCP peer (re)connects that completed the HELLO handshake.",
+            ln,
+        )
+        self.count_send_retried = p.new_counter(
+            NET_SEND_RETRIED_KEY,
+            "Frames re-sent after a mid-frame abrupt close.",
+            ln,
+        )
+        self.count_send_dropped = p.new_counter(
+            NET_SEND_DROPPED_KEY,
+            "Frames dropped after exhausting connect/send retries.",
+            ln,
+        )
 
 
 class MetricsObs(_Bundle):
@@ -1110,6 +1157,11 @@ __all__ = [
     "NET_REORDERED_KEY",
     "NET_REPLAYED_KEY",
     "NET_INJECTED_KEYS",
+    "NET_RECONNECT_ATTEMPTS_KEY",
+    "NET_RECONNECT_SUCCESS_KEY",
+    "NET_SEND_RETRIED_KEY",
+    "NET_SEND_DROPPED_KEY",
+    "NET_RECONNECT_KEYS",
     "OBS_SAMPLES_KEY",
     "OBS_ANOMALY_COMMIT_STALL_KEY",
     "OBS_ANOMALY_VIEW_CHANGE_STORM_KEY",

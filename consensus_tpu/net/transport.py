@@ -9,10 +9,21 @@ co-located accelerator, not inter-replica consensus).
 
 Contract fidelity: ``Comm`` is *fire-and-forget, unordered, unreliable*
 (the protocol tolerates loss).  Accordingly: sends never block the replica
-loop (a bounded per-peer queue + writer thread), connection failures drop
-messages silently and trigger lazy reconnection with backoff, and inbound
-frames are posted onto the replica's scheduler (thread-safe with
-``RealtimeScheduler``).
+loop (a bounded per-peer queue + writer thread), connection failures trip
+bounded in-writer retry (exponential backoff + jitter) before the frame is
+dropped silently, and inbound frames are posted onto the replica's
+scheduler (thread-safe with ``RealtimeScheduler``).
+
+Reconnect hardening (deploy rig): a connection-refused peer (killed and
+not yet restarted) or a mid-frame abrupt close (killed while we were
+writing) never surfaces to the caller — the writer thread retries the
+connect up to ``connect_attempts`` times with capped exponential backoff
+and jitter, and re-sends an abruptly interrupted frame up to
+``send_retries`` times over a fresh connection.  Only after both budgets
+are exhausted is the frame dropped (the unreliable contract).  Every
+outcome is booked on the pinned ``net_reconnect_*`` / ``net_send_*``
+counters when a :class:`~consensus_tpu.metrics.MetricsNetwork` bundle is
+attached, so a soak scraper can attribute chaos-induced churn per process.
 
 Identity: every connection opens with a HELLO frame that *pins* the peer id
 for that connection; later frames claiming another sender kill the link.
@@ -36,6 +47,7 @@ import hmac
 import logging
 import os
 import queue
+import random
 import socket
 import struct
 import threading
@@ -83,19 +95,28 @@ class TcpComm(Comm):
         *,
         send_queue_depth: int = 1000,
         reconnect_backoff: float = 0.5,
+        reconnect_backoff_max: float = 5.0,
+        connect_attempts: int = 3,
+        send_retries: int = 2,
         connect_timeout: float = 2.0,
         auth_secret: Optional[bytes] = None,
+        metrics=None,
         fault_plan=None,
     ) -> None:
         #: Optional testing FaultPlan (consensus_tpu/testing/faults.py):
         #: arms the net.send.io_error / net.recv.short_read seams below.
         #: A single ``is None`` check when unarmed.
         self.fault_plan = fault_plan
+        #: Optional MetricsNetwork bundle booking reconnect/retry outcomes.
+        self.metrics = metrics
         self.self_id = self_id
         self._addresses = dict(addresses)
         self._on_message = on_message
         self._queue_depth = send_queue_depth
         self._backoff = reconnect_backoff
+        self._backoff_max = reconnect_backoff_max
+        self._connect_attempts = max(1, connect_attempts)
+        self._send_retries = max(0, send_retries)
         self._connect_timeout = connect_timeout
         self._auth_secret = auth_secret
         # One-slot encode memo: broadcasts send the same message object to
@@ -106,21 +127,26 @@ class TcpComm(Comm):
         self._inbound: set[socket.socket] = set()
         self._inbound_lock = threading.Lock()
         self._stopped = threading.Event()
+        self._listener_paused = False
+        self._listener_lock = threading.Lock()
 
     # --- lifecycle ---------------------------------------------------------
 
-    def start(self) -> None:
-        """Bind our listen address and spin up per-peer sender threads."""
+    def _bind_listener(self) -> None:
         host, port = self._addresses[self.self_id]
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind((host, port))
         listener.listen(16)
         self._listener = listener
-        accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"comm-{self.self_id}-accept", daemon=True
-        )
-        accept_thread.start()
+        threading.Thread(
+            target=self._accept_loop, args=(listener,),
+            name=f"comm-{self.self_id}-accept", daemon=True,
+        ).start()
+
+    def start(self) -> None:
+        """Bind our listen address and spin up per-peer sender threads."""
+        self._bind_listener()
         for node_id, addr in self._addresses.items():
             if node_id == self.self_id:
                 continue
@@ -128,9 +154,67 @@ class TcpComm(Comm):
             self._peers[node_id] = peer
             peer.start()
 
+    def pause_listener(self) -> None:
+        """Chaos hook (deploy rig: "listener-port drop"): close the listen
+        socket and sever inbound connections.  Outbound sending is
+        untouched; peers see connection-refused and ride the bounded-retry
+        path until :meth:`resume_listener` rebinds the same address."""
+        with self._listener_lock:
+            if self._listener_paused or self._stopped.is_set():
+                return
+            self._listener_paused = True
+            if self._listener is not None:
+                # shutdown() before close(): on Linux, close() alone does
+                # not wake a thread blocked in accept(), and the parked
+                # accept keeps the kernel socket in LISTEN — pinning the
+                # port against the rebind in resume_listener().
+                try:
+                    self._listener.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+                self._listener = None
+        with self._inbound_lock:
+            inbound = list(self._inbound)
+            self._inbound.clear()
+        for conn in inbound:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def resume_listener(self) -> None:
+        """Undo :meth:`pause_listener`: rebind the listen address and start
+        a fresh accept thread."""
+        with self._listener_lock:
+            if not self._listener_paused or self._stopped.is_set():
+                return
+            self._listener_paused = False
+            # Sockets severed by pause_listener can linger in FIN_WAIT on
+            # the listen port until the remote notices; retry the rebind
+            # briefly rather than fail the heal.
+            for attempt in range(100):
+                try:
+                    self._bind_listener()
+                    return
+                except OSError:
+                    if attempt == 99 or self._stopped.wait(0.05):
+                        raise
+
     def stop(self) -> None:
         self._stopped.set()
         if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
@@ -192,19 +276,28 @@ class TcpComm(Comm):
 
     # --- inbound -----------------------------------------------------------
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, listener: socket.socket) -> None:
         while not self._stopped.is_set():
             try:
-                conn, _ = self._listener.accept()
+                conn, _ = listener.accept()
             except OSError:
                 if self._stopped.is_set():
                     return
+                if self._listener is not listener:
+                    return  # paused/replaced: this accept loop retires
                 # Transient accept failure (ECONNABORTED, fd pressure):
                 # keep serving — a dead accept loop would silently
                 # partition this replica on the receive side.
                 logger.warning("%d: accept failed; retrying", self.self_id, exc_info=True)
                 self._stopped.wait(0.05)
                 continue
+            # Accepted sockets share the listen port as their local addr;
+            # without SO_REUSEADDR a severed-but-lingering one (FIN_WAIT
+            # after pause_listener) would block the rebind on resume.
+            try:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            except OSError:
+                pass
             with self._inbound_lock:
                 if self._stopped.is_set():
                     conn.close()
@@ -331,54 +424,89 @@ class _Peer:
                 frame = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
+            self._send_with_retry(frame)
+
+    def _send_with_retry(self, frame: bytes) -> None:
+        """Deliver one frame, riding out a peer killed mid-frame: an abrupt
+        close during ``sendall`` reconnects and re-sends the SAME frame up
+        to ``send_retries`` times before the fire-and-forget drop."""
+        metrics = self._comm.metrics
+        for attempt in range(self._comm._send_retries + 1):
             sock = self._ensure_connected()
             if sock is None:
-                continue  # drop the frame; peer unreachable right now
+                break  # connect budget exhausted; drop below
             try:
                 plan = self._comm.fault_plan
                 if plan is not None:
                     plan.io_error("net.send.io_error")
                 sock.sendall(frame)
+                return
             except OSError:
                 self._drop_connection()
+                if attempt < self._comm._send_retries:
+                    if metrics is not None:
+                        metrics.count_send_retried.add(1)
+                    continue
+        if metrics is not None:
+            metrics.count_send_dropped.add(1)
 
     def _ensure_connected(self) -> Optional[socket.socket]:
+        """Bounded connect: up to ``connect_attempts`` tries with capped
+        exponential backoff + jitter (desynchronizes a fleet reconnecting
+        to a restarted peer), then give up on THIS frame — the next frame
+        starts a fresh budget, so a peer that stays down costs bounded
+        writer time and a peer that comes back is re-reached quickly."""
         if self._sock is not None:
             return self._sock
-        if self._comm._stopped.is_set():
-            return None
-        try:
-            sock = socket.create_connection(
-                self.addr, timeout=self._comm._connect_timeout
-            )
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            # Read the acceptor's challenge nonce, answer with the proof.
-            sock.settimeout(self._comm._connect_timeout)
-            header = _read_exact(sock, _HEADER.size)
-            if header is None:
-                raise OSError("peer closed during handshake")
-            length, _, kind = _HEADER.unpack(header)
-            if kind != _KIND_HELLO or length != _NONCE_BYTES:
-                raise OSError("bad handshake challenge")
-            nonce = _read_exact(sock, length)
-            if nonce is None:
-                raise OSError("peer closed during handshake")
-            sock.settimeout(None)
-            proof = _hello_proof(
-                self._comm._auth_secret, nonce, self._comm.self_id
-            )
-            sock.sendall(
-                _HEADER.pack(len(proof), self._comm.self_id, _KIND_HELLO) + proof
-            )
-            self._sock = sock
-            logger.info(
-                "%d: connected to peer %d at %s:%d",
-                self._comm.self_id, self.node_id, *self.addr,
-            )
-            return sock
-        except OSError:
-            self._comm._stopped.wait(self._comm._backoff)
-            return None
+        comm = self._comm
+        metrics = comm.metrics
+        for attempt in range(comm._connect_attempts):
+            if comm._stopped.is_set():
+                return None
+            if attempt:
+                delay = min(
+                    comm._backoff * (2.0 ** (attempt - 1)), comm._backoff_max
+                )
+                delay *= 0.5 + random.random() / 2.0  # jitter: 50-100%
+                if comm._stopped.wait(delay):
+                    return None
+            if metrics is not None:
+                metrics.count_reconnect_attempts.add(1)
+            try:
+                sock = socket.create_connection(
+                    self.addr, timeout=comm._connect_timeout
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # Read the acceptor's challenge nonce, answer with the proof.
+                sock.settimeout(comm._connect_timeout)
+                header = _read_exact(sock, _HEADER.size)
+                if header is None:
+                    raise OSError("peer closed during handshake")
+                length, _, kind = _HEADER.unpack(header)
+                if kind != _KIND_HELLO or length != _NONCE_BYTES:
+                    raise OSError("bad handshake challenge")
+                nonce = _read_exact(sock, length)
+                if nonce is None:
+                    raise OSError("peer closed during handshake")
+                sock.settimeout(None)
+                proof = _hello_proof(comm._auth_secret, nonce, comm.self_id)
+                sock.sendall(
+                    _HEADER.pack(len(proof), comm.self_id, _KIND_HELLO) + proof
+                )
+                self._sock = sock
+                if metrics is not None:
+                    metrics.count_reconnect_success.add(1)
+                logger.info(
+                    "%d: connected to peer %d at %s:%d",
+                    comm.self_id, self.node_id, *self.addr,
+                )
+                return sock
+            except OSError:
+                continue
+        # Budget exhausted: brief pause so a hard-down peer cannot spin the
+        # writer thread at full speed frame after frame.
+        comm._stopped.wait(comm._backoff)
+        return None
 
     def _drop_connection(self) -> None:
         if self._sock is not None:

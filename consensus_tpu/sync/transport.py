@@ -170,9 +170,20 @@ def _read_frame(conn: socket.socket) -> Optional[bytes]:
 
 
 def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes or fail CLEANLY with None.
+
+    A peer killed mid-frame (kill -9, RST, or a stall past the socket
+    timeout) must never hang the listener thread or hand a truncated
+    buffer to the codec: EOF, ECONNRESET, and timeouts all collapse to
+    None here, and every caller treats None as "this fetch yielded
+    nothing" — the chunk is not applied, the connection is dropped, and
+    the listener keeps serving other peers."""
     buf = b""
     while len(buf) < n:
-        part = conn.recv(n - len(buf))
+        try:
+            part = conn.recv(n - len(buf))
+        except OSError:  # includes socket.timeout: bounded, never a hang
+            return None
         if not part:
             return None
         buf += part

@@ -161,6 +161,29 @@ def test_lint_covers_parallel_plane():
     )
 
 
+def test_lint_covers_deploy_plane():
+    """The deployment rig is inherently real-time — process lifecycles,
+    socket deadlines, scrape timestamps — so its wall-clock reads are
+    legitimate, but each one must be an AUDITED ``# wallclock-ok`` escape,
+    not an unmarked read the next refactor copies into protocol code.  Run
+    the lint rooted at consensus_tpu/deploy/ (presence of the expected
+    modules first): rc 0 means every read in the tree carries the marker."""
+    deploy_dir = os.path.join(_REPO, "consensus_tpu", "deploy")
+    present = {f for f in os.listdir(deploy_dir) if f.endswith(".py")}
+    assert {"spec.py", "control.py", "supervisor.py", "launcher.py",
+            "autoscaler.py", "invariants.py", "chaos.py", "identity.py",
+            "replica_main.py", "sidecar_main.py", "driver_main.py"} <= present
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, deploy_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        "deploy plane has unaudited wall-clock reads:\n"
+        + proc.stdout + proc.stderr
+    )
+
+
 def test_lint_covers_storage_fault_layer():
     """The storage-fault injector (testing/storage.py) and the WAL scrubber
     (wal/scrub.py) both promise seed-deterministic, injected-clock-only

@@ -248,3 +248,196 @@ def test_auth_secret_rejects_wrong_key():
             good.stop()
     finally:
         comm2.stop()
+
+
+# --------------------------------------------------------------------------
+# Deploy-rig hardening regressions: abrupt peer death on both channels.
+
+
+def test_sync_listener_survives_partial_frames_and_rst():
+    """A peer killed mid-frame (kill -9 shape: EOF after a partial header,
+    a truncated payload, or a hard RST) must not hang the SyncListener or
+    half-apply a chunk — and the listener must keep serving afterwards."""
+    import struct as _struct
+
+    from consensus_tpu.sync import LedgerDecisionStore, SyncListener, SyncServer
+    from consensus_tpu.sync.transport import TcpSyncTransport
+    from consensus_tpu.types import Proposal
+
+    ledger = [
+        Decision(proposal=Proposal(payload=f"block-{i}".encode()))
+        for i in range(1, 4)
+    ]
+    listener = SyncListener(SyncServer(LedgerDecisionStore(ledger)))
+    try:
+        # 1) EOF after a partial u32 header.
+        c = socket.create_connection(listener.address, timeout=5)
+        c.sendall(b"\x00\x00")
+        c.close()
+        # 2) Header promises 100 bytes, connection dies after 10 (RST).
+        c = socket.create_connection(listener.address, timeout=5)
+        c.sendall(_struct.pack(">I", 100) + b"x" * 10)
+        c.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     _struct.pack("ii", 1, 0))  # RST on close
+        c.close()
+        time.sleep(0.1)
+        # 3) The listener still answers a well-formed fetch.
+        transport = TcpSyncTransport(9, {1: listener.address}, timeout=5.0)
+        from consensus_tpu.wire import SyncRequest
+
+        reply = transport.fetch(1, SyncRequest(from_seq=1, to_seq=3))
+        assert reply is not None and len(reply.decisions) == 3
+    finally:
+        listener.close()
+
+
+def test_sync_fetch_fails_clean_when_server_dies_mid_reply():
+    """The client half of the same contract: a server that accepts and then
+    closes without a full reply yields None (no hang, no partial chunk)."""
+    from consensus_tpu.sync.transport import TcpSyncTransport
+    from consensus_tpu.wire import SyncRequest
+
+    server = socket.create_server(("127.0.0.1", 0))
+    address = server.getsockname()
+    done = threading.Event()
+
+    def half_reply():
+        conn, _ = server.accept()
+        conn.recv(65536)          # swallow the request
+        conn.sendall(b"\x00\x00\x00\x40" + b"y" * 5)  # promise 64, send 5
+        conn.close()
+        done.set()
+
+    t = threading.Thread(target=half_reply, daemon=True)
+    t.start()
+    try:
+        transport = TcpSyncTransport(9, {1: address}, timeout=2.0)
+        t0 = time.monotonic()
+        reply = transport.fetch(1, SyncRequest(from_seq=1, to_seq=1))
+        assert reply is None
+        assert time.monotonic() - t0 < 5.0, "fetch hung instead of failing"
+        assert done.wait(2.0)
+    finally:
+        server.close()
+
+
+def test_tcp_comm_reconnect_retry_metrics_and_recovery():
+    """Satellite-1 hardening: connection-refused gets bounded retries with
+    the pinned reconnect counters booked, and frames flow once the peer
+    comes up (a supervisor-restarted process reuses its spec'd port)."""
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+
+    ports = free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    provider = Metrics(InMemoryProvider())
+    comm1 = TcpComm(
+        1, addrs, lambda *a: None,
+        reconnect_backoff=0.02, connect_attempts=2, send_retries=1,
+        metrics=provider.network,
+    )
+    comm1.start()
+    received = []
+    got = threading.Event()
+    try:
+        # Peer 2 is down: the frame rides the bounded retry path and is
+        # dropped, with attempts and the drop booked.
+        comm1.send_consensus(2, HeartBeat(view=1, seq=1))
+        deadline = time.monotonic() + 5.0
+        p = provider.provider
+        while time.monotonic() < deadline:
+            if p.value("net_send_dropped") >= 1:
+                break
+            time.sleep(0.02)
+        assert p.value("net_send_dropped") >= 1
+        assert p.value("net_reconnect_attempts") >= 2  # both budgeted tries
+        assert p.value("net_reconnect_success") == 0
+
+        # Peer restarts on the SAME port (the deploy restart contract):
+        # the next frame reconnects and is delivered.
+        comm2 = TcpComm(2, addrs, lambda s, m, r: (received.append(m), got.set()))
+        comm2.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not got.is_set():
+                comm1.send_consensus(2, HeartBeat(view=7, seq=7))
+                got.wait(0.2)
+            assert got.is_set(), "no frame delivered after peer came back"
+            assert received[0].view == 7
+            assert p.value("net_reconnect_success") >= 1
+        finally:
+            comm2.stop()
+    finally:
+        comm1.stop()
+
+
+def test_tcp_comm_resends_frame_after_midframe_abrupt_close():
+    """A peer killed while we were writing (OSError from sendall) must not
+    lose the frame: the writer reconnects and re-sends it, booking the
+    pinned retry counter — the fire-and-forget drop fires only after the
+    retry budget."""
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+    from consensus_tpu.testing.faults import FaultPlan
+
+    ports = free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    received = []
+    got = threading.Event()
+    comm2 = TcpComm(2, addrs, lambda s, m, r: (received.append(m), got.set()))
+    comm2.start()
+    provider = Metrics(InMemoryProvider())
+    # net.send.io_error armed for hit 1: the FIRST write dies exactly as if
+    # the peer vanished mid-frame; the retry path must deliver it anyway.
+    comm1 = TcpComm(
+        1, addrs, lambda *a: None,
+        reconnect_backoff=0.02, send_retries=2,
+        metrics=provider.network,
+        fault_plan=FaultPlan("net.send.io_error", on_hit=1),
+    )
+    comm1.start()
+    try:
+        comm1.send_consensus(2, HeartBeat(view=3, seq=9))
+        assert got.wait(10.0), "frame lost to a mid-frame abrupt close"
+        assert received[0].seq == 9
+        assert provider.provider.value("net_send_retried") >= 1
+        assert provider.provider.value("net_send_dropped") == 0
+    finally:
+        comm1.stop()
+        comm2.stop()
+
+
+def test_tcp_comm_listener_pause_resume():
+    """The deploy chaos verb: pause_listener drops the listen port (inbound
+    peers see refused + severed links), resume_listener rebinds the same
+    address and frames flow again."""
+    ports = free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    received = []
+    comm2 = TcpComm(2, addrs, lambda s, m, r: received.append(m))
+    comm2.start()
+    comm1 = TcpComm(1, addrs, lambda *a: None, reconnect_backoff=0.02,
+                    connect_attempts=1)
+    comm1.start()
+    try:
+        comm1.send_consensus(2, HeartBeat(view=1, seq=1))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not received:
+            time.sleep(0.02)
+        assert received, "baseline frame not delivered"
+
+        comm2.pause_listener()
+        time.sleep(0.1)
+        n = len(received)
+        comm1.send_consensus(2, HeartBeat(view=2, seq=2))
+        time.sleep(0.5)
+        assert len(received) == n, "frame delivered through a dropped listener"
+
+        comm2.resume_listener()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(received) == n:
+            comm1.send_consensus(2, HeartBeat(view=3, seq=3))
+            time.sleep(0.2)
+        assert len(received) > n, "no frames after listener resume"
+        assert received[-1].view == 3
+    finally:
+        comm1.stop()
+        comm2.stop()
