@@ -58,8 +58,9 @@ class ClusterLauncher:
         self.replicas: Dict[int, NodeSupervisor] = {}
         self.sidecars: Dict[str, NodeSupervisor] = {}
         self.flight_dir = os.path.join(spec.base_dir, "flight")
-        #: Every pid this launcher ever spawned (orphan audit at stop()).
-        self.all_pids: list = []
+        #: Every supervisor this launcher ever created, including drained
+        #: sidecars (orphan audit at stop() walks their Popen handles).
+        self._all_sups: list = []
         self._sidecar_window: Dict[str, dict] = {}
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -71,7 +72,7 @@ class ClusterLauncher:
     # ------------------------------------------------------------- boot
 
     def _make_supervisor(self, name, argv, control_addr) -> NodeSupervisor:
-        return NodeSupervisor(
+        sup = NodeSupervisor(
             name,
             argv,
             control_addr,
@@ -81,6 +82,8 @@ class ClusterLauncher:
             max_restarts=self.max_restarts,
             env=self._env,
         )
+        self._all_sups.append(sup)
+        return sup
 
     def _replica_argv(self, node_id: int) -> list:
         return [
@@ -105,7 +108,6 @@ class ClusterLauncher:
             )
             self.sidecars[sc.sidecar_id] = sup
             sup.start()
-            self.all_pids.append(sup.pid)
         for r in self.spec.replicas:
             sup = self._make_supervisor(
                 f"replica-{r.node_id}",
@@ -114,7 +116,6 @@ class ClusterLauncher:
             )
             self.replicas[r.node_id] = sup
             sup.start()
-            self.all_pids.append(sup.pid)
         for sup in list(self.sidecars.values()) + list(self.replicas.values()):
             remaining = deadline - time.monotonic()  # wallclock-ok
             if remaining <= 0 or not sup.wait_healthy(remaining):
@@ -245,7 +246,6 @@ class ClusterLauncher:
         )
         self.sidecars[sc.sidecar_id] = sup
         sup.start()
-        self.all_pids.append(sup.pid)
         if not sup.wait_healthy(timeout):
             raise TimeoutError(f"{sc.sidecar_id} failed to come up")
         logger.info("autoscaler: added %s", sc.sidecar_id)
@@ -278,20 +278,17 @@ class ClusterLauncher:
         listen port survives.  Returns the teardown summary."""
         for sup in list(self.replicas.values()) + list(self.sidecars.values()):
             sup.stop()
+        # Belt and braces: every process EVER spawned — including
+        # pre-restart incarnations and drained sidecars — must be gone.
+        # Audit Popen handles, not raw pids: poll() answers for exactly
+        # the child we spawned, whereas a reaped pid can be recycled by
+        # an unrelated same-user process over a multi-hour soak and make
+        # os.kill(pid, 0) report a false orphan.
         orphans = []
-        for sup in list(self.replicas.values()) + list(self.sidecars.values()):
-            if sup.alive:
-                orphans.append(f"{sup.name} pid {sup.pid}")
-        # Belt and braces: every pid EVER spawned (including pre-restart
-        # incarnations the supervisors already reaped) must be gone.
-        for pid in self.all_pids:
-            if pid is None:
-                continue
-            try:
-                os.kill(pid, 0)
-            except (OSError, ProcessLookupError):
-                continue
-            orphans.append(f"pid {pid} (spawned earlier) still running")
+        for sup in self._all_sups:
+            for proc in sup.spawned:
+                if proc.poll() is None:
+                    orphans.append(f"{sup.name} pid {proc.pid} still running")
         leaked = []
         for port in self._listen_ports():
             probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -27,6 +27,16 @@ from typing import Optional
 
 
 def free_ports(n: int) -> list:
+    """``n`` currently-free localhost ports, picked by bind-then-close.
+
+    This is inherently TOCTOU: between close and the child's own bind
+    another process can claim a port.  Acceptable for a localhost test
+    rig — a lost race surfaces loudly (child bind failure -> supervisor
+    flight record + bounded restarts; resume_listener keeps the paused
+    flag on rebind failure so the heal retries) rather than corrupting
+    anything.  All sockets are held open until every port is drawn so
+    one call never hands out duplicates.
+    """
     socks = [socket.socket() for _ in range(n)]
     for s in socks:
         s.bind(("127.0.0.1", 0))

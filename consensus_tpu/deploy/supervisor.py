@@ -12,7 +12,13 @@ replays its WAL); this is that layer for the rig.  One
   (:class:`~consensus_tpu.deploy.control.ControlClient`),
 * **restart** with capped exponential backoff + jitter when the child
   dies and restart is enabled — a ``kill -9`` leader comes back as the
-  same node id with the same config file and its intact WAL directory,
+  same node id with the same config file and its intact WAL directory.
+  ``max_restarts`` caps CONSECUTIVE failures, not lifetime restarts: a
+  child that survives past ``healthy_uptime`` resets the failure count
+  (and the backoff exponent), so a multi-hour soak can kill the same
+  replica hundreds of times while a genuine crash loop (config error,
+  port conflict — every incarnation dying within seconds) still gives
+  up after ``max_restarts`` attempts,
 * **flight-record capture on death**: every exit writes a JSON record
   (exit code / signal, uptime, restart count, stderr tail) under
   ``flight/`` so a multi-hour soak leaves a forensically useful trail
@@ -56,6 +62,7 @@ class NodeSupervisor:
         backoff_initial: float = 0.25,
         backoff_max: float = 5.0,
         max_restarts: int = 8,
+        healthy_uptime: Optional[float] = None,
         stderr_tail_lines: int = 60,
         env: Optional[dict] = None,
         probe_timeout: float = 2.0,
@@ -68,9 +75,25 @@ class NodeSupervisor:
         self._backoff_initial = backoff_initial
         self._backoff_max = backoff_max
         self._max_restarts = max_restarts
+        #: Uptime past which an incarnation counts as healthy and resets
+        #: the consecutive-failure budget.  Must sit well above interpreter
+        #: boot (so an instant crash loop never resets) and well below the
+        #: cadence of legitimate external kills (chaos, deploys).
+        self._healthy_uptime = (
+            healthy_uptime if healthy_uptime is not None
+            else max(2.0 * backoff_max, 5.0)
+        )
         self._tail_lines = stderr_tail_lines
         self._env = dict(env) if env is not None else None
+        #: Lifetime restart count (reporting/flight records).
         self.restarts = 0
+        #: Deaths since the last healthy incarnation — drives the cap and
+        #: the backoff exponent.
+        self.consecutive_failures = 0
+        #: Every Popen this supervisor ever spawned, in spawn order.  The
+        #: launcher's teardown orphan audit polls these handles instead of
+        #: raw pids (a reaped pid can be recycled by an unrelated process).
+        self.spawned: list = []
         self.flight_records: list = []
         self._proc: Optional[subprocess.Popen] = None
         self._tail: "collections.deque[str]" = collections.deque(
@@ -100,6 +123,7 @@ class NodeSupervisor:
             text=True,
         )
         self._proc = proc
+        self.spawned.append(proc)
         self._spawned_at = time.monotonic()  # wallclock-ok
         threading.Thread(
             target=self._stderr_pump, args=(proc,),
@@ -125,6 +149,12 @@ class NodeSupervisor:
     def _wait_loop(self, proc: subprocess.Popen) -> None:
         rc = proc.wait()
         uptime = time.monotonic() - self._spawned_at  # wallclock-ok
+        if uptime >= self._healthy_uptime:
+            # This incarnation ran long enough to count as healthy: an
+            # external kill (chaos, operator), not a crash loop.  Reset
+            # the consecutive-failure budget and the backoff exponent so
+            # a multi-hour soak never exhausts a lifetime cap.
+            self.consecutive_failures = 0
         record = self._flight_record(rc, uptime)
         if self._stopping.is_set():
             return
@@ -132,10 +162,14 @@ class NodeSupervisor:
             "%s: pid %d died (%s) after %.1fs", self.name, proc.pid,
             record["cause"], uptime,
         )
-        if not self.restart_enabled or self.restarts >= self._max_restarts:
+        if (
+            not self.restart_enabled
+            or self.consecutive_failures >= self._max_restarts
+        ):
             return
         delay = min(
-            self._backoff_initial * (2.0 ** self.restarts), self._backoff_max
+            self._backoff_initial * (2.0 ** self.consecutive_failures),
+            self._backoff_max,
         )
         delay *= 0.5 + random.random() / 2.0  # jitter: fleet desync
         if self._stopping.wait(delay):
@@ -144,15 +178,27 @@ class NodeSupervisor:
             if self._stopping.is_set() or self._proc is not proc:
                 return
             self.restarts += 1
+            self.consecutive_failures += 1
             self._spawn_locked()
 
     def _flight_record(self, rc: int, uptime: float) -> dict:
-        cause = f"exit {rc}" if rc >= 0 else f"signal {signal.Signals(-rc).name}"
+        if rc >= 0:
+            sig_name = None
+            cause = f"exit {rc}"
+        else:
+            try:
+                sig_name = signal.Signals(-rc).name
+            except ValueError:  # platform-specific / real-time signal
+                sig_name = f"signal {-rc}"
+            cause = (
+                f"signal {sig_name}" if not sig_name.startswith("signal ")
+                else sig_name
+            )
         record = {
             "name": self.name,
             "pid": self._proc.pid if self._proc else None,
             "exit_code": rc if rc >= 0 else None,
-            "signal": signal.Signals(-rc).name if rc < 0 else None,
+            "signal": sig_name,
             "cause": cause,
             "uptime_secs": round(uptime, 3),
             "restarts": self.restarts,

@@ -129,6 +129,9 @@ class TcpComm(Comm):
         self._stopped = threading.Event()
         self._listener_paused = False
         self._listener_lock = threading.Lock()
+        # resume_listener rebind retry bounds (chaos heal vs FIN_WAIT).
+        self._rebind_attempts = 100
+        self._rebind_delay = 0.05
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -136,8 +139,12 @@ class TcpComm(Comm):
         host, port = self._addresses[self.self_id]
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind((host, port))
-        listener.listen(16)
+        try:
+            listener.bind((host, port))
+            listener.listen(16)
+        except OSError:
+            listener.close()
+            raise
         self._listener = listener
         threading.Thread(
             target=self._accept_loop, args=(listener,),
@@ -196,17 +203,26 @@ class TcpComm(Comm):
         with self._listener_lock:
             if not self._listener_paused or self._stopped.is_set():
                 return
-            self._listener_paused = False
             # Sockets severed by pause_listener can linger in FIN_WAIT on
             # the listen port until the remote notices; retry the rebind
             # briefly rather than fail the heal.
-            for attempt in range(100):
+            attempts = self._rebind_attempts
+            for attempt in range(attempts):
                 try:
                     self._bind_listener()
-                    return
+                    break
                 except OSError:
-                    if attempt == 99 or self._stopped.wait(0.05):
+                    if (
+                        attempt == attempts - 1
+                        or self._stopped.wait(self._rebind_delay)
+                    ):
+                        # Still paused: the flag only clears on a
+                        # successful rebind, so a later resume_listener
+                        # (e.g. the chaos heal re-issued over the control
+                        # socket) retries instead of silently no-opping
+                        # into a permanent inbound partition.
                         raise
+            self._listener_paused = False
 
     def stop(self) -> None:
         self._stopped.set()

@@ -177,6 +177,7 @@ class TestPoints:
 
 
 def make_sigs(n, msg_prefix=b"m"):
+    pytest.importorskip("cryptography", reason="reference signer unavailable")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 

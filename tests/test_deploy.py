@@ -164,6 +164,50 @@ def test_supervisor_stops_restart_budget_exhausted(tmp_path):
     sup.assert_reaped()
 
 
+def test_supervisor_healthy_uptime_resets_restart_budget(tmp_path):
+    # max_restarts caps CONSECUTIVE failures, not lifetime restarts: a
+    # child that survives past healthy_uptime resets the budget, so a
+    # soak can kill the same replica more times than max_restarts and
+    # the supervisor keeps bringing it back.
+    sup = NodeSupervisor(
+        "soak-child",
+        _sleeper_argv(),
+        ("127.0.0.1", 1),
+        flight_dir=str(tmp_path / "flight"),
+        backoff_initial=0.01,
+        backoff_max=0.05,
+        max_restarts=1,
+        healthy_uptime=0.3,
+        probe_timeout=0.2,
+    )
+    sup.start()
+    try:
+        for kill_round in range(1, 4):  # 3 kills > max_restarts=1
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if sup.alive and (
+                    time.monotonic() - sup._spawned_at
+                ) >= 0.35:
+                    break
+                time.sleep(0.05)
+            assert sup.alive, f"child not back before kill {kill_round}"
+            sup.kill(signal.SIGKILL)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if sup.restarts == kill_round and sup.alive:
+                    break
+                time.sleep(0.05)
+            assert sup.restarts == kill_round and sup.alive, (
+                f"supervisor gave up after kill {kill_round} "
+                "(lifetime cap instead of consecutive-failure cap)"
+            )
+            # Every healthy death reset the budget.
+            assert sup.consecutive_failures == 1
+    finally:
+        sup.stop()
+    sup.assert_reaped()
+
+
 def test_supervisor_suspend_is_not_a_death(tmp_path):
     sup = NodeSupervisor(
         "frozen-child",

@@ -88,6 +88,7 @@ class TestPointsP256:
 
 
 def make_sigs(n):
+    pytest.importorskip("cryptography", reason="reference signer unavailable")
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
 
@@ -156,6 +157,9 @@ class _SigOnly(EcdsaP256VerifierMixin):
 
 class TestPortAdapters:
     def test_sign_and_batch_verify_quorum(self):
+        pytest.importorskip(
+            "cryptography", reason="EcdsaP256Signer needs a real signer"
+        )
         signers = {i: EcdsaP256Signer(i) for i in (1, 2, 3)}
         verifier = _SigOnly({i: s.public_bytes for i, s in signers.items()})
         proposal = Proposal(payload=b"batch")
@@ -167,6 +171,9 @@ class TestPortAdapters:
         assert verifier.verify_consenter_sigs_batch([tampered], proposal) == [None]
 
     def test_raw_signature_path(self):
+        pytest.importorskip(
+            "cryptography", reason="EcdsaP256Signer needs a real signer"
+        )
         signer = EcdsaP256Signer(5)
         verifier = _SigOnly({5: signer.public_bytes})
         data = b"view-data"
@@ -178,6 +185,9 @@ class TestPortAdapters:
 def test_cluster_orders_with_real_p256_signatures():
     # The protocol running entirely on ECDSA-P256: decisions carry verifying
     # quorums under the registered keys.
+    pytest.importorskip(
+        "cryptography", reason="EcdsaP256Signer needs a real signer"
+    )
     from consensus_tpu.models.verifier import commit_message
     from consensus_tpu.testing import TestApp
 

@@ -110,6 +110,7 @@ def test_pallas_scan_zero_and_small_digits():
 
 
 def _test_corpus(n=8):
+    pytest.importorskip("cryptography", reason="reference signer unavailable")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
@@ -244,6 +245,7 @@ def test_pallas_p256_scan_matches_xla_reference():
 def test_full_p256_verifier_parity_with_pallas_flag(monkeypatch):
     """End-to-end A/B on identical inputs for the P-256 family."""
     import consensus_tpu.models.ecdsa_p256 as model
+    pytest.importorskip("cryptography", reason="reference signer unavailable")
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
 

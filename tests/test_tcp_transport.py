@@ -441,3 +441,44 @@ def test_tcp_comm_listener_pause_resume():
     finally:
         comm1.stop()
         comm2.stop()
+
+
+def test_tcp_comm_resume_listener_failure_stays_healable():
+    """A failed resume (port stolen during the pause window) must NOT
+    clear the paused flag: the next resume_listener retries the rebind
+    instead of silently no-opping into a permanent inbound partition."""
+    ports = free_ports(2)
+    addrs = {1: ("127.0.0.1", ports[0]), 2: ("127.0.0.1", ports[1])}
+    received = []
+    comm2 = TcpComm(2, addrs, lambda s, m, r: received.append(m))
+    comm2.start()
+    comm1 = TcpComm(1, addrs, lambda *a: None, reconnect_backoff=0.02,
+                    connect_attempts=1)
+    comm1.start()
+    try:
+        comm2.pause_listener()
+        comm2._rebind_attempts = 3  # keep the failing resume fast
+        comm2._rebind_delay = 0.01
+
+        def stolen_port():
+            raise OSError("port stolen during the pause window")
+
+        real_bind = comm2._bind_listener
+        comm2._bind_listener = stolen_port
+        try:
+            with pytest.raises(OSError):
+                comm2.resume_listener()
+        finally:
+            comm2._bind_listener = real_bind
+        # The paused flag survived the failure, so this retry (the chaos
+        # heal re-issuing net_resume) actually rebinds and heals.
+        comm2.resume_listener()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not received:
+            comm1.send_consensus(2, HeartBeat(view=7, seq=7))
+            time.sleep(0.2)
+        assert received, "listener never healed after a failed resume"
+        assert received[-1].view == 7
+    finally:
+        comm1.stop()
+        comm2.stop()
