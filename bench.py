@@ -952,6 +952,125 @@ def bench_deploy_main() -> int:
     return 0
 
 
+#: Fixed shapes for the host-side sharding family: the same per-group
+#: load at 1, 2 and 4 groups, all certs through ONE shared wave former.
+GROUPS_SHAPES = (1, 2, 4)
+GROUPS_TENANTS_PER_GROUP = 4
+GROUPS_ROUNDS = 2
+GROUPS_SEED = 17
+GROUPS_WINDOW = 0.05
+
+
+def bench_groups() -> dict:
+    """``groups`` family: horizontal sharding over one shared fleet.
+
+    For each shape (1, 2, 4 groups) stands up a :class:`ShardedCluster`
+    with the same per-group load (batch size 1 so a request is a
+    decision), orders every request, then replays the committed cert
+    workload through ONE shared ``FairShareWaveFormer`` — one OS thread
+    per group, the deployment shape.  Reports aggregate committed tx per
+    wall-second per shape, and pins the shared-fleet win as numbers: the
+    4-group launch-size histogram and the count of launches that served
+    2+ groups in one fused sweep."""
+    from collections import Counter
+
+    from consensus_tpu.groups.cluster import ShardedCluster
+    from consensus_tpu.metrics import InMemoryProvider, Metrics
+
+    by_groups: dict[str, dict] = {}
+    histogram: dict[str, int] = {}
+    multi_group_launches = 0
+    for shape in GROUPS_SHAPES:
+        tenants = [
+            f"bench-t{i}" for i in range(GROUPS_TENANTS_PER_GROUP * shape)
+        ]
+        shard = ShardedCluster(
+            shape, n=4, seed=GROUPS_SEED,
+            config_tweaks={
+                "request_batch_max_count": 1,
+                "request_batch_max_interval": 0.01,
+            },
+            metrics=Metrics(InMemoryProvider()),
+        )
+        per_group: dict[str, int] = {gid: 0 for gid in shard.group_ids()}
+        for t in tenants:
+            per_group[shard.router.directory.assign(t)] += GROUPS_ROUNDS
+        t0 = time.perf_counter()
+        shard.start()
+        for r in range(GROUPS_ROUNDS):
+            for t in tenants:
+                shard.submit(t, b"b%d" % r)
+        if not shard.run_until_heights(
+            {g: h for g, h in per_group.items() if h}, max_time=600.0
+        ):
+            raise RuntimeError(f"{shape}-group shard did not commit")
+        shared = shard.drive_shared_fleet(window=GROUPS_WINDOW)
+        elapsed = time.perf_counter() - t0
+        shard.assert_clean()
+        committed = len(tenants) * GROUPS_ROUNDS
+        by_groups[str(shape)] = {
+            "committed_tx_per_sec": round(
+                committed / elapsed if elapsed > 0 else 0.0, 1
+            ),
+            "committed": committed,
+            "launches": shared["launches"],
+            "total_signatures": shared["total_signatures"],
+        }
+        if shape == GROUPS_SHAPES[-1]:
+            histogram = {
+                str(size): k
+                for size, k in sorted(Counter(shared["launch_sizes"]).items())
+            }
+            multi_group_launches = shared["multi_group_launches"]
+    top = str(GROUPS_SHAPES[-1])
+    return {
+        "metric": "groups_aggregate_throughput",
+        "value": by_groups[top]["committed_tx_per_sec"],
+        "unit": "tx/sec",
+        "by_groups": by_groups,
+        "scaling_vs_one_group": round(
+            by_groups[top]["committed_tx_per_sec"]
+            / by_groups["1"]["committed_tx_per_sec"], 3
+        ) if by_groups["1"]["committed_tx_per_sec"] else 0.0,
+        "launch_histogram": histogram,
+        "multi_group_launches": multi_group_launches,
+    }
+
+
+def bench_groups_main() -> int:
+    """The ``groups`` family entry point: live measurement with the same
+    structured-skip + last-good trail discipline as the other host
+    families."""
+    metric = "groups_aggregate_throughput"
+    try:
+        record = bench_groups()
+    except Exception as exc:  # noqa: BLE001 — any failure becomes a skip
+        last_good = _load_last_good(metric)
+        print(json.dumps({
+            "metric": metric,
+            "skipped": "groups-bench-error",
+            "detail": repr(exc),
+            "last_good": dict(last_good, stale=True) if last_good else None,
+        }))
+        return 0
+    _save_last_good(
+        metric, record["value"], record["scaling_vs_one_group"],
+        unit="tx/sec", hardware="host (sim groups, shared former)",
+    )
+    print(json.dumps(record))
+    top = record["by_groups"][str(GROUPS_SHAPES[-1])]
+    print(
+        f"# groups {record['value']:.0f} tx/s aggregate at "
+        f"{GROUPS_SHAPES[-1]} groups "
+        f"({record['scaling_vs_one_group']:.2f}x vs 1 group), "
+        f"{top['launches']} shared-fleet launches for "
+        f"{top['total_signatures']} sigs, "
+        f"{record['multi_group_launches']} multi-group",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main() -> None:
     from __graft_entry__ import _enable_compile_cache
 
@@ -966,6 +1085,9 @@ def main() -> None:
     if family == "deploy":
         # Host-side family: the process-per-replica rig on localhost.
         sys.exit(bench_deploy_main())
+    if family == "groups":
+        # Host-side family: sharded groups over one shared wave former.
+        sys.exit(bench_groups_main())
     metric = {
         "p256": "ecdsa_p256_verify_throughput",
         "cert_verify": "cert_verify_throughput",

@@ -197,8 +197,10 @@ def check_in_flight(
 
     The consolidated quorum-intersection argument for this deviation —
     why the relaxation is safe with f byzantine replicas, and why the
-    residual sub-f+1 split below stays unresolvable — lives in SAFETY.md
-    at the repository root."""
+    residual sub-f+1 split below stays unresolvable — lives in
+    docs/inflight-safety.md (the standalone writeup, with the seed-1268
+    wedge walked number by number) and in SAFETY.md at the repository
+    root."""
     expected_seq = (
         max(
             (

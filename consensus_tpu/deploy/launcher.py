@@ -48,7 +48,13 @@ class ClusterLauncher:
         python: str = sys.executable,
         backoff_initial: float = 0.25,
         max_restarts: int = 8,
+        spawn_sidecars: bool = True,
     ) -> None:
+        #: ``spawn_sidecars=False`` — consensus sharding: the spec's
+        #: sidecars are a SHARED fleet owned by another launcher (the
+        #: first group's), so this launcher neither boots, audits, nor
+        #: port-checks them; replicas still dial them at verify time.
+        self.spawn_sidecars = spawn_sidecars
         self.spec = spec
         self.python = python
         self.restart = restart
@@ -99,8 +105,14 @@ class ClusterLauncher:
 
     def start(self, timeout: float = 120.0) -> None:
         self.spec.write()
+        # Ports reserved at generate time (hold_ports=True) stay BOUND
+        # until this moment: release just before spawn, so no concurrent
+        # launcher could have drawn them in the meantime (spec.py
+        # PortReservation — the free_ports TOCTOU fix).
+        self.spec.release_ports()
         deadline = time.monotonic() + timeout  # wallclock-ok
-        for sc in self.spec.sidecars:
+        sidecars = self.spec.sidecars if self.spawn_sidecars else []
+        for sc in sidecars:
             sup = self._make_supervisor(
                 sc.sidecar_id,
                 self._sidecar_argv(sc.sidecar_id),
@@ -269,8 +281,11 @@ class ClusterLauncher:
         ports = []
         for r in self.spec.replicas:
             ports += [r.port, r.sync_port, r.control_port]
-        for s in self.spec.sidecars:
-            ports += [s.port, s.control_port]
+        if self.spawn_sidecars:
+            # A shared fleet (spawn_sidecars=False) is audited by the
+            # launcher that owns it — its ports are legitimately busy here.
+            for s in self.spec.sidecars:
+                ports += [s.port, s.control_port]
         return ports
 
     def stop(self) -> dict:

@@ -26,24 +26,66 @@ from dataclasses import asdict, dataclass, field
 from typing import Optional
 
 
-def free_ports(n: int) -> list:
-    """``n`` currently-free localhost ports, picked by bind-then-close.
+class PortReservation:
+    """``n`` localhost ports, BOUND AND HELD until :meth:`release`.
 
-    This is inherently TOCTOU: between close and the child's own bind
-    another process can claim a port.  Acceptable for a localhost test
-    rig — a lost race surfaces loudly (child bind failure -> supervisor
-    flight record + bounded restarts; resume_listener keeps the paused
-    flag on rebind failure so the heal retries) rather than corrupting
-    anything.  All sockets are held open until every port is drawn so
-    one call never hands out duplicates.
+    The old ``free_ports`` picked ports by bind-then-close, leaving a
+    TOCTOU window from spec generation all the way to child spawn: two
+    launchers generating specs concurrently could each draw the other's
+    just-closed ports and collide at boot.  A reservation keeps the
+    sockets bound, so the kernel itself arbitrates — while one launcher
+    holds its reservation, no other ``PortReservation``/``free_ports``
+    call (or anything else binding an ephemeral port) can be handed any
+    of its ports.  The launcher releases JUST BEFORE spawning children
+    (``ClusterLauncher.start``), shrinking the race window from
+    "generate -> spawn" to the microseconds between ``close()`` and the
+    child's own ``bind()`` — and that residual race is against random
+    ephemeral allocation, not against another launcher's deliberate
+    reuse of the same port list.
     """
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+
+    def __init__(self, n: int, host: str = "127.0.0.1") -> None:
+        self._socks = []
+        try:
+            for _ in range(n):
+                s = socket.socket()
+                s.bind((host, 0))
+                self._socks.append(s)
+        except OSError:
+            self.release()
+            raise
+        #: The reserved port numbers, stable for the reservation's life.
+        self.ports = [s.getsockname()[1] for s in self._socks]
+
+    @property
+    def held(self) -> bool:
+        return bool(self._socks)
+
+    def release(self) -> None:
+        """Close every held socket (idempotent) — call immediately before
+        handing the ports to child processes."""
+        socks, self._socks = self._socks, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "PortReservation":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def free_ports(n: int) -> list:
+    """``n`` currently-free localhost ports (bind-then-close, released
+    immediately).  Callers that go on to spawn processes on these ports
+    should prefer :class:`PortReservation` + ``hold_ports=True`` on
+    ``ClusterSpec.generate``: a released port can be claimed by anyone
+    between this call and the child's own bind."""
+    with PortReservation(n) as reservation:
+        return list(reservation.ports)
 
 
 @dataclass
@@ -91,9 +133,16 @@ class ClusterSpec:
         clients: int = 8,
         host: str = "127.0.0.1",
         config_overrides: Optional[dict] = None,
+        hold_ports: bool = False,
     ) -> "ClusterSpec":
+        """Mint a spec on fresh localhost ports.  ``hold_ports=True`` keeps
+        the ports BOUND (a :class:`PortReservation` attached to the spec)
+        until the launcher releases them right before spawn — the fix for
+        the generate-to-spawn TOCTOU; two concurrent launchers holding
+        reservations can never draw overlapping port sets."""
         os.makedirs(base_dir, exist_ok=True)
-        ports = free_ports(3 * n + 2 * n_sidecars)
+        reservation = PortReservation(3 * n + 2 * n_sidecars, host=host)
+        ports = reservation.ports
         spec = cls(
             n=n,
             base_dir=os.path.abspath(base_dir),
@@ -125,7 +174,31 @@ class ClusterSpec:
                     control_port=ports[3 * n + 2 * k + 1],
                 )
             )
+        if hold_ports:
+            spec.attach_reservation(reservation)
+        else:
+            reservation.release()
         return spec
+
+    # Deliberately UNANNOTATED class attribute — not a dataclass field, so
+    # reservations stay process-local: never serialized into cluster.json,
+    # never survive a load().
+    _reservation = None
+
+    def attach_reservation(self, reservation: PortReservation) -> None:
+        self._reservation = reservation
+
+    def release_ports(self) -> None:
+        """Release a held :class:`PortReservation` (idempotent; no-op for
+        specs generated without ``hold_ports``) — the launcher calls this
+        immediately before spawning children."""
+        reservation = self._reservation
+        if reservation is not None:
+            reservation.release()
+
+    @property
+    def ports_held(self) -> bool:
+        return self._reservation is not None and self._reservation.held
 
     def add_sidecar(self) -> SidecarSpec:
         """Mint a spec for one more sidecar process (autoscaler scale-up).
@@ -216,4 +289,10 @@ class ClusterSpec:
         return Configuration(**defaults)
 
 
-__all__ = ["ClusterSpec", "ReplicaSpec", "SidecarSpec", "free_ports"]
+__all__ = [
+    "ClusterSpec",
+    "PortReservation",
+    "ReplicaSpec",
+    "SidecarSpec",
+    "free_ports",
+]

@@ -132,6 +132,7 @@ class IngressDriver:
         sample_interval: float = 1.0,
         service_rate: float = 2000.0,
         queue_limit: int = 512,
+        groups: int = 0,
     ) -> None:
         if servers < 1:
             raise ValueError("driver needs at least one fleet server")
@@ -152,6 +153,21 @@ class IngressDriver:
             rate=spec.admission_rate, burst=spec.admission_burst,
             metrics=self.metrics.ingress, tracer=tracer,
         )
+        #: ``groups >= 1`` turns on consensus sharding: every ADMITTED
+        #: request is also routed to its owning consensus group
+        #: (admit-then-route — admission stays global so a flooder cannot
+        #: escape its budget by hashing into a quiet group).  Off by
+        #: default; summaries without groups stay byte-identical.
+        self.group_router = None
+        if groups:
+            from consensus_tpu.groups.directory import GroupDirectory
+            from consensus_tpu.groups.router import GroupRouter
+
+            self.group_router = GroupRouter(
+                GroupDirectory.of_size(groups),
+                metrics=self.metrics.groups,
+                tracer=tracer,
+            )
         self.detectors = DetectorBank(thresholds)
         self.anomalies: list = []
         self.offered_honest = 0
@@ -182,6 +198,8 @@ class IngressDriver:
             return
         if event.honest:
             self.admitted_honest += 1
+        if self.group_router is not None:
+            self.group_router.route(event.tenant)
         hops = 0
         for server_id in self.ring.candidates(event.tenant):
             if self.fleet.try_enqueue(server_id, event, self._on_done):
@@ -241,7 +259,7 @@ class IngressDriver:
         for a in self.anomalies:
             counts[a.kind] = counts.get(a.kind, 0) + 1
         adm = self.admission
-        return {
+        out = {
             "seed": self.seed,
             "clients": self.spec.clients,
             "servers": len(self.server_ids),
@@ -262,6 +280,12 @@ class IngressDriver:
             "latency_p99": round(_percentile(lat, 0.99), 9),
             "anomalies": dict(sorted(counts.items())),
         }
+        if self.group_router is not None:
+            # Keys appear ONLY in groups mode so a non-sharded summary is
+            # byte-identical to every pre-sharding run of the same seed.
+            out["groups"] = len(self.group_router.directory)
+            out["group_routed"] = self.group_router.counts()
+        return out
 
     def summary_json(self) -> str:
         """Sorted-key JSON — the byte-identical same-seed artifact."""

@@ -69,6 +69,7 @@ OBS_ANOMALY_DEDUP_STORM_KEY = "obs_anomaly_dedup_storm"
 OBS_ANOMALY_ENGINE_DEGRADED_KEY = "obs_anomaly_engine_degraded"
 OBS_ANOMALY_WAL_CORRUPTION_KEY = "obs_anomaly_wal_corruption"
 OBS_ANOMALY_WAL_STALL_KEY = "obs_anomaly_wal_stall"
+OBS_ANOMALY_CROSS_GROUP_STALL_KEY = "obs_anomaly_cross_group_stall"
 OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_COMMIT_STALL_KEY,
     OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
@@ -81,6 +82,7 @@ OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_ENGINE_DEGRADED_KEY,
     OBS_ANOMALY_WAL_CORRUPTION_KEY,
     OBS_ANOMALY_WAL_STALL_KEY,
+    OBS_ANOMALY_CROSS_GROUP_STALL_KEY,
 )
 
 #: Pinned instrument names for durable-state self-healing (wal/scrub.py,
@@ -211,6 +213,28 @@ ENGINE_KEYS = (
     ENGINE_COMPILE_CACHE_MISSES_KEY,
 )
 
+#: Consensus-sharding (groups) plane.  Fed by the ingress GroupRouter
+#: (routed counter + directory-size gauge), the shared FairShareWaveFormer
+#: (cross-GROUP wave-span histogram + multi-group launch counter), and the
+#: cross-group 2PC coordinator/participants.  Aggregate names are pinned;
+#: per-group series are ``with_labels(group)`` children.
+GROUPS_ROUTED_KEY = "groups_routed_total"
+GROUPS_COUNT_KEY = "groups_count"
+GROUPS_WAVE_SPAN_KEY = "groups_wave_span"
+GROUPS_WAVE_MULTI_KEY = "groups_wave_multi_group_total"
+GROUPS_TWOPC_STARTED_KEY = "groups_twopc_started_total"
+GROUPS_TWOPC_COMMITTED_KEY = "groups_twopc_committed_total"
+GROUPS_TWOPC_ABORTED_KEY = "groups_twopc_aborted_total"
+GROUPS_KEYS = (
+    GROUPS_ROUTED_KEY,
+    GROUPS_COUNT_KEY,
+    GROUPS_WAVE_SPAN_KEY,
+    GROUPS_WAVE_MULTI_KEY,
+    GROUPS_TWOPC_STARTED_KEY,
+    GROUPS_TWOPC_COMMITTED_KEY,
+    GROUPS_TWOPC_ABORTED_KEY,
+)
+
 #: THE module-level registry of every pinned instrument name: key -> one-line
 #: description.  Tests and embedder dashboards key on this mapping; every
 #: name here is created by a fresh ``Metrics`` bundle (asserted by
@@ -262,6 +286,9 @@ PINNED_METRIC_KEYS: dict[str, str] = {
     OBS_ANOMALY_WAL_STALL_KEY:
         "detector firings: a replica's WAL stopped accepting appends "
         "(degraded: ENOSPC or fsync-retry cap)",
+    OBS_ANOMALY_CROSS_GROUP_STALL_KEY:
+        "detector firings: a cross-group atomic transaction stuck "
+        "unresolved past the stall window",
     WAL_FSYNC_RETRY_KEY:
         "group-commit fsync attempts that failed and were re-armed",
     WAL_SCRUB_RUNS_KEY:
@@ -341,6 +368,21 @@ PINNED_METRIC_KEYS: dict[str, str] = {
     ENGINE_COMPILE_CACHE_MISSES_KEY:
         "engine constructions that traced a kernel fresh (first build of "
         "that topology, or the memo disabled)",
+    GROUPS_ROUTED_KEY:
+        "admitted requests routed to their owning consensus group",
+    GROUPS_COUNT_KEY:
+        "consensus groups currently in the placement directory (gauge)",
+    GROUPS_WAVE_SPAN_KEY:
+        "distinct consensus groups sharing one fused verify launch "
+        "(histogram)",
+    GROUPS_WAVE_MULTI_KEY:
+        "fused verify launches serving submissions from two or more groups",
+    GROUPS_TWOPC_STARTED_KEY:
+        "cross-group atomic transactions entering the prepare phase",
+    GROUPS_TWOPC_COMMITTED_KEY:
+        "cross-group atomic transactions decided commit by every group",
+    GROUPS_TWOPC_ABORTED_KEY:
+        "cross-group atomic transactions decided abort by every group",
 }
 
 
@@ -894,6 +936,12 @@ class MetricsObs(_Bundle):
             "WAL-stall detector firings (degraded: appends refused).",
             ln,
         )
+        self.count_anomaly_cross_group_stall = p.new_counter(
+            OBS_ANOMALY_CROSS_GROUP_STALL_KEY,
+            "Cross-group-stall detector firings (a 2PC transaction stuck "
+            "unresolved past the stall window).",
+            ln,
+        )
 
     def anomaly_counter(self, kind: str) -> Counter:
         """The pinned counter for detector ``kind`` (its short name, e.g.
@@ -1072,6 +1120,54 @@ class MetricsEngine(_Bundle):
         )
 
 
+class MetricsGroups(_Bundle):
+    """Consensus-sharding instruments — consensus_tpu addition, fed by the
+    ingress :class:`~consensus_tpu.groups.router.GroupRouter` (routed
+    counter + directory gauge), the shared
+    :class:`~consensus_tpu.models.engine.FairShareWaveFormer` (one wave-span
+    observation per fused launch; the multi-group counter bumps when a
+    launch serves two or more groups — the cross-GROUP coalescing win), and
+    the cross-group 2PC machinery (started/committed/aborted lifecycle)."""
+
+    def __init__(self, p: Provider, label_names: Sequence[str] = ()) -> None:
+        ln = extend_label_names((), label_names)
+        self.count_routed = p.new_counter(
+            GROUPS_ROUTED_KEY,
+            "Admitted requests routed to their owning consensus group.",
+            ln,
+        )
+        self.group_count = p.new_gauge(
+            GROUPS_COUNT_KEY,
+            "Consensus groups currently in the placement directory.",
+            ln,
+        )
+        self.wave_span = p.new_histogram(
+            GROUPS_WAVE_SPAN_KEY,
+            "Distinct consensus groups sharing one fused verify launch.",
+            ln,
+        )
+        self.count_wave_multi_group = p.new_counter(
+            GROUPS_WAVE_MULTI_KEY,
+            "Fused verify launches serving two or more groups.",
+            ln,
+        )
+        self.count_twopc_started = p.new_counter(
+            GROUPS_TWOPC_STARTED_KEY,
+            "Cross-group atomic transactions entering the prepare phase.",
+            ln,
+        )
+        self.count_twopc_committed = p.new_counter(
+            GROUPS_TWOPC_COMMITTED_KEY,
+            "Cross-group atomic transactions decided commit by every group.",
+            ln,
+        )
+        self.count_twopc_aborted = p.new_counter(
+            GROUPS_TWOPC_ABORTED_KEY,
+            "Cross-group atomic transactions decided abort by every group.",
+            ln,
+        )
+
+
 class MetricsViewChange(_Bundle):
     """Parity: reference pkg/api/metrics.go:548-578 (3 instruments)."""
 
@@ -1113,6 +1209,7 @@ class Metrics:
         self.sidecar = MetricsSidecar(provider, label_names)
         self.ingress = MetricsIngress(provider, label_names)
         self.engine = MetricsEngine(provider, label_names)
+        self.groups = MetricsGroups(provider, label_names)
 
     def with_labels(self, *values: str) -> "Metrics":
         """Bind embedder label values on every bundle (e.g. the channel id).
@@ -1149,6 +1246,7 @@ __all__ = [
     "MetricsSidecar",
     "MetricsIngress",
     "MetricsEngine",
+    "MetricsGroups",
     "extend_label_names",
     "VERIFY_LAUNCH_BATCH_KEY",
     "WAL_RECORDS_PER_FSYNC_KEY",
@@ -1174,6 +1272,7 @@ __all__ = [
     "OBS_ANOMALY_ENGINE_DEGRADED_KEY",
     "OBS_ANOMALY_WAL_CORRUPTION_KEY",
     "OBS_ANOMALY_WAL_STALL_KEY",
+    "OBS_ANOMALY_CROSS_GROUP_STALL_KEY",
     "OBS_ANOMALY_KEYS",
     "WAL_FSYNC_RETRY_KEY",
     "WAL_SCRUB_RUNS_KEY",
@@ -1216,5 +1315,13 @@ __all__ = [
     "ENGINE_CROSSCHECK_MISMATCH_KEY",
     "ENGINE_RUNG_KEY",
     "ENGINE_KEYS",
+    "GROUPS_ROUTED_KEY",
+    "GROUPS_COUNT_KEY",
+    "GROUPS_WAVE_SPAN_KEY",
+    "GROUPS_WAVE_MULTI_KEY",
+    "GROUPS_TWOPC_STARTED_KEY",
+    "GROUPS_TWOPC_COMMITTED_KEY",
+    "GROUPS_TWOPC_ABORTED_KEY",
+    "GROUPS_KEYS",
     "PINNED_METRIC_KEYS",
 ]

@@ -488,11 +488,12 @@ class AdmissionReject(Exception):
 
 
 class _TenantPending(_Pending):
-    __slots__ = ("tenant",)
+    __slots__ = ("tenant", "group")
 
-    def __init__(self, tenant, messages, signatures, keys):
+    def __init__(self, tenant, messages, signatures, keys, group=None):
         super().__init__(messages, signatures, keys)
         self.tenant = tenant
+        self.group = group
 
 
 class FairShareWaveFormer:
@@ -525,6 +526,20 @@ class FairShareWaveFormer:
     ``on_wave(tenant_counts, total)`` fires after each successful launch
     with the per-tenant signature counts that rode it — the sidecar's
     metrics/kernel-accounting hook.
+
+    **Cross-GROUP coalescing** (consensus sharding): ``submit`` takes an
+    optional ``group`` id.  When present, the admission identity becomes
+    (group, tenant) — each group's replicas get their own bounded queues
+    and their own fair-share slot — and one fused launch serves
+    submissions from several consensus groups at once.  SAFETY §7 is
+    preserved by construction: waves are formed from WHOLE submissions
+    (``_take_wave`` never splits one), so every quorum cert's signatures
+    ride a single engine call and no cert ever mixes engines.  Per-wave
+    group composition is booked through ``groups_metrics`` (a
+    :class:`~consensus_tpu.metrics.MetricsGroups` bundle: one
+    ``groups_wave_span`` observation per launch, plus the multi-group
+    counter when a launch spans two or more groups) and surfaced raw via
+    ``on_group_wave(group_counts, total)``.
     """
 
     def __init__(
@@ -535,6 +550,8 @@ class FairShareWaveFormer:
         max_wave: int = 8192,
         tenant_queue_limit: int = 4096,
         on_wave: Optional[Callable[[dict, int], None]] = None,
+        on_group_wave: Optional[Callable[[dict, int], None]] = None,
+        groups_metrics=None,
         wait_timeout: float = 300.0,
         name: str = "verify-waves",
     ) -> None:
@@ -546,6 +563,8 @@ class FairShareWaveFormer:
         self._wave_target = _slice_wave_target(engine, self._max_wave)
         self._tenant_queue_limit = max(1, tenant_queue_limit)
         self._on_wave = on_wave
+        self._on_group_wave = on_group_wave
+        self._groups_metrics = groups_metrics
         self._wait_timeout = wait_timeout
         self._cv = threading.Condition()
         self._queues: dict[str, list[_TenantPending]] = {}
@@ -555,35 +574,51 @@ class FairShareWaveFormer:
         self._thread = threading.Thread(target=self._loop, daemon=True, name=name)
         self._thread.start()
 
-    def queue_depth(self, tenant: str) -> int:
-        """Signatures currently queued for ``tenant``."""
+    @staticmethod
+    def _admission_key(tenant: str, group: Optional[str]) -> str:
+        """The queue/fair-share identity: the tenant alone (sidecar mode),
+        or (group, tenant) under consensus sharding — a group's replicas
+        never contend on another group's admission budget."""
+        return tenant if group is None else f"{group}\x1f{tenant}"
+
+    def queue_depth(self, tenant: str, group: Optional[str] = None) -> int:
+        """Signatures currently queued for ``tenant`` (within ``group``
+        when the group id is part of the admission identity)."""
+        key = self._admission_key(tenant, group)
         with self._cv:
-            return sum(len(i.messages) for i in self._queues.get(tenant, ()))
+            return sum(len(i.messages) for i in self._queues.get(key, ()))
 
     @property
     def pending_count(self) -> int:
         return self._count
 
-    def submit(self, tenant: str, messages, signatures, public_keys) -> np.ndarray:
+    def submit(
+        self, tenant: str, messages, signatures, public_keys,
+        *, group: Optional[str] = None,
+    ) -> np.ndarray:
         """Queue one tenant submission and block until its wave lands.
-        Raises :class:`AdmissionReject` when the tenant's queue is full."""
+        Raises :class:`AdmissionReject` when the tenant's queue is full.
+        ``group`` joins the admission identity under consensus sharding —
+        the submission stays whole either way (SAFETY §7)."""
         n = len(messages)
         if not (n == len(signatures) == len(public_keys)):
             raise ValueError("batch length mismatch")
         if n == 0:
             return np.zeros(0, dtype=bool)
+        key = self._admission_key(tenant, group)
         with self._cv:
             if self._closed:
                 raise RuntimeError("wave former is closed")
-            depth = sum(len(i.messages) for i in self._queues.get(tenant, ()))
+            depth = sum(len(i.messages) for i in self._queues.get(key, ()))
             if depth + n > self._tenant_queue_limit:
-                raise AdmissionReject(tenant, depth, self._tenant_queue_limit)
-            q = self._queues.get(tenant)
+                raise AdmissionReject(key, depth, self._tenant_queue_limit)
+            q = self._queues.get(key)
             if q is None:
-                q = self._queues[tenant] = []
-                self._rr.append(tenant)
+                q = self._queues[key] = []
+                self._rr.append(key)
             item = _TenantPending(
-                tenant, list(messages), list(signatures), list(public_keys)
+                tenant, list(messages), list(signatures), list(public_keys),
+                group=group,
             )
             q.append(item)
             self._count += n
@@ -681,6 +716,21 @@ class FairShareWaveFormer:
                     self._on_wave(tenant_counts, len(messages))
                 except Exception:
                     logger.exception("on_wave hook failed (ignored)")
+            group_counts: dict[str, int] = {}
+            for item in wave:
+                if item.group is not None:
+                    group_counts[item.group] = (
+                        group_counts.get(item.group, 0) + len(item.messages)
+                    )
+            if group_counts and self._groups_metrics is not None:
+                self._groups_metrics.wave_span.observe(float(len(group_counts)))
+                if len(group_counts) >= 2:
+                    self._groups_metrics.count_wave_multi_group.add(1)
+            if group_counts and self._on_group_wave is not None:
+                try:
+                    self._on_group_wave(group_counts, len(messages))
+                except Exception:
+                    logger.exception("on_group_wave hook failed (ignored)")
             for item, piece in zip(wave, slices):
                 item.result = piece
                 item.done.set()

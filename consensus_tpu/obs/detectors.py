@@ -6,7 +6,7 @@ fixed-seed run is byte-identical across replays.  Detectors are
 EDGE-TRIGGERED: a condition fires once at onset and re-arms only after the
 condition clears, so a 300-second stall is one anomaly, not 300.
 
-The eleven kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
+The twelve kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
 
 ``commit_stall``        a running node has pending pool work but its ledger
                         has not grown for ``stall_window`` sim-seconds
@@ -49,6 +49,13 @@ The eleven kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
                         node stopped proposing/voting while still serving
                         sync and reads; clears when an append/probe fsync
                         succeeds
+``cross_group_stall``   a cross-group atomic (2PC) transaction has been
+                        unresolved — prepared but neither committed nor
+                        aborted everywhere — for
+                        ``cross_group_stall_window`` sim-seconds (fed by
+                        the groups harness via the optional
+                        ``groups_twopc_oldest_age`` health field); clears
+                        when the oldest in-flight transaction resolves
 
 The two ingress detectors read OPTIONAL health fields
 (``ingress_offered`` / ``ingress_rate_limited`` / ``ingress_dedup_hits``,
@@ -78,6 +85,7 @@ ANOMALY_KINDS = (
     "engine_degraded",
     "wal_corruption",
     "wal_stall",
+    "cross_group_stall",
 )
 
 
@@ -98,11 +106,12 @@ class DetectorThresholds:
     overload_reject_fraction: float = 0.5
     dedup_min_offered: int = 20
     dedup_hit_fraction: float = 0.5
+    cross_group_stall_window: float = 60.0
 
     def validate(self) -> None:
         if self.stall_window <= 0 or self.storm_window <= 0 or self.flap_window <= 0:
             raise ValueError("detector windows must be positive")
-        if self.churn_window <= 0:
+        if self.churn_window <= 0 or self.cross_group_stall_window <= 0:
             raise ValueError("detector windows must be positive")
         if min(self.storm_views, self.flap_changes,
                self.lag_decisions, self.collapse_decisions,
@@ -342,6 +351,20 @@ class DetectorBank:
                     fired, "wal_stall", nid, t, bool(wal_deg),
                     "WAL refusing appends (fsync/append failures); node "
                     "stopped proposing and voting until the disk heals",
+                )
+
+            # --- cross-group 2PC stall ---------------------------------
+            twopc_age = h.get("groups_twopc_oldest_age")
+            if twopc_age is None:
+                # Not a sharded-deployment sample: discard the latch so
+                # pre-groups health streams stay byte-identical.
+                self._active.discard(("cross_group_stall", nid))
+            else:
+                self._edge(
+                    fired, "cross_group_stall", nid, t,
+                    twopc_age >= th.cross_group_stall_window,
+                    f"oldest cross-group transaction unresolved for "
+                    f"{twopc_age:g}s (window {th.cross_group_stall_window:g}s)",
                 )
 
             # --- verify-launch-rate collapse ---------------------------

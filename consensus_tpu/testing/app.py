@@ -521,6 +521,7 @@ class Cluster:
         scrub_interval: Optional[float] = None,
         sync_mode: str = "wire",
         obs=None,
+        scheduler=None,
     ) -> None:
         #: > 0 gives every node group-commit durability semantics
         #: (DeferredMemWAL): appends become durable — and their deferred
@@ -548,7 +549,12 @@ class Cluster:
         #: node id -> live SyncServer (wire mode); a crashed node serves
         #: nothing, exactly like its consensus ingress.
         self.sync_servers: dict[int, SyncServer] = {}
-        self.scheduler = SimScheduler()
+        #: Injectable virtual clock: a ShardedCluster hands every group ONE
+        #: shared SimScheduler so cross-group time is a single total order;
+        #: None (the default) keeps the private-clock construction
+        #: bit-for-bit as before.  Each cluster always owns its own
+        #: SimNetwork (per-group partitions/heals stay per-group).
+        self.scheduler = scheduler if scheduler is not None else SimScheduler()
         self.network = SimNetwork(self.scheduler, seed=seed)
         self.network.set_membership(list(range(1, n + 1)), epoch=0)
         self.nodes: dict[int, Node] = {}

@@ -57,7 +57,7 @@ class Violation:
     """One invariant failure, pinned to the sim clock and the adversary
     actions executed before it."""
 
-    invariant: str  # "prefix-agreement" | "quorum-cert" | "epoch-cert" | "durable-before-visible" | "liveness"
+    invariant: str  # "prefix-agreement" | "quorum-cert" | "epoch-cert" | "durable-before-visible" | "cross-group-atomicity" | "liveness"
     sim_time: float
     node: Optional[int]
     detail: str
@@ -125,7 +125,24 @@ class InvariantMonitor:
         #: is still live.  Hook failures must not mask the violation.
         self.on_violation: list = []
         self.deliveries = 0
+        #: Cross-group atomicity wiring (consensus sharding): a shared
+        #: CrossGroupRegistry + this monitor's group id, installed via
+        #: :meth:`attach_cross_group`.  None on single-group clusters.
+        self.cross_group_registry = None
+        self.cross_group_id: Optional[str] = None
+        self._cross_group_seen = 0
         cluster.delivery_hooks.append(self._on_deliver)
+
+    def attach_cross_group(self, registry, group_id: str) -> None:
+        """Mirror the shared registry's cross-group atomicity verdicts
+        into THIS monitor at every delivery (SAFETY.md §15): a violation
+        involving this group surfaces with the group's own sim-time and
+        adversary history attached.  Install the registry-feeding
+        participant hook BEFORE this monitor was constructed so the
+        delivery that completes a one-sided commit is judged immediately."""
+        self.cross_group_registry = registry
+        self.cross_group_id = group_id
+        self._cross_group_seen = len(registry.violations)
 
     # --- recording ---------------------------------------------------------
 
@@ -160,6 +177,24 @@ class InvariantMonitor:
         self._check_quorum_cert(node_id, decision)
         if self.check_durability:
             self._check_durable_before_visible(node_id, decision)
+        self._check_cross_group_atomicity(node_id)
+
+    def _check_cross_group_atomicity(self, node_id: int) -> None:
+        """Mirror any NEW cross-group atomicity violations the shared
+        registry recorded (the participant hook runs before this monitor,
+        so the registry is up to date for this very delivery)."""
+        registry = self.cross_group_registry
+        if registry is None:
+            return
+        fresh = registry.violations[self._cross_group_seen:]
+        self._cross_group_seen = len(registry.violations)
+        for violation in fresh:
+            self.record(
+                "cross-group-atomicity",
+                node_id,
+                f"[{self.cross_group_id}] {violation.detail} "
+                f"(txid {violation.txid})",
+            )
 
     def _check_prefix_agreement(self, node_id: Optional[int] = None) -> None:
         """Every pair of ledgers agrees on its common digest prefix."""

@@ -37,6 +37,7 @@ from consensus_tpu.wire.messages import (
     SavedCommit,
     SavedMessage,
     SavedNewView,
+    SavedTwoPC,
     SavedViewChange,
     SignedViewData,
     StateTransferRequest,
@@ -44,6 +45,7 @@ from consensus_tpu.wire.messages import (
     SyncChunk,
     SyncRequest,
     SyncSnapshotMeta,
+    TWOPC_PHASES,
     ViewChange,
     ViewData,
     ViewMetadata,
@@ -733,6 +735,28 @@ def _r_saved_view_change(r: _Reader, version: int) -> SavedViewChange:
     return SavedViewChange(view_change=_r_view_change(r))
 
 
+def _w_saved_twopc(w: _Writer, m: SavedTwoPC) -> None:
+    if m.phase not in TWOPC_PHASES:
+        raise CodecError(f"unknown 2PC phase {m.phase!r}")
+    w.text(m.txid)
+    w.u8(TWOPC_PHASES.index(m.phase))
+    w.seq(m.groups, w.text)
+    w.text(m.coordinator)
+
+
+def _r_saved_twopc(r: _Reader, version: int) -> SavedTwoPC:
+    txid = r.text()
+    phase_idx = r.u8()
+    if phase_idx >= len(TWOPC_PHASES):
+        raise CodecError(f"unknown 2PC phase index {phase_idx}")
+    groups = r.seq(r.text)
+    coordinator = r.text()
+    return SavedTwoPC(
+        txid=txid, phase=TWOPC_PHASES[phase_idx],
+        groups=tuple(groups), coordinator=coordinator,
+    )
+
+
 # Tags mirror the SavedMessage oneof (smartbftprotos/messages.proto:113-120).
 # Readers take (reader, envelope_version) — the WAL-record domain is
 # versioned independently of the wire messages so a record-layout change
@@ -741,13 +765,17 @@ def _r_saved_view_change(r: _Reader, version: int) -> SavedViewChange:
 # v3: half-aggregated quorum certs — SavedCommit gained an optional
 #     QuorumCert and ProposedRecord's nested PrePrepare is encoded at wire
 #     v2 so its prev-commit field can carry one.
-_SAVED_VERSION = 3
+# v4: cross-group sharding — SavedTwoPC (tag 5) persists a 2PC participant
+#     transition; only SavedTwoPC records emit v4, so every WAL without
+#     cross-group transactions stays bit-for-bit its pre-groups encoding.
+_SAVED_VERSION = 4
 
 _SAVED_CODECS: dict[int, tuple[type, Callable, Callable]] = {
     1: (ProposedRecord, _w_proposed_record, _r_proposed_record),
     2: (SavedCommit, _w_saved_commit, _r_saved_commit),
     3: (SavedNewView, _w_saved_new_view, _r_saved_new_view),
     4: (SavedViewChange, _w_saved_view_change, _r_saved_view_change),
+    5: (SavedTwoPC, _w_saved_twopc, _r_saved_twopc),
 }
 
 _SAVED_TAG_BY_TYPE = {cls: tag for tag, (cls, _, _) in _SAVED_CODECS.items()}
@@ -774,6 +802,10 @@ def _saved_version_for(msg: SavedMessage) -> int:
         return 1
     if isinstance(msg, SavedCommit) and msg.cert is not None:
         return 3
+    if isinstance(msg, SavedTwoPC):
+        # The record kind itself is new in v4; there is no older encoding
+        # that could express it.
+        return 4
     return 1
 
 

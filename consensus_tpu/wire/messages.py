@@ -330,8 +330,33 @@ class SavedViewChange:
     view_change: ViewChange
 
 
+#: The observable phases of a cross-group 2PC participant, in order.
+TWOPC_PHASES = ("prepared", "committed", "aborted")
+
+
+@dataclass(frozen=True)
+class SavedTwoPC:
+    """WAL record (saved v4): one cross-group 2PC participant transition.
+
+    consensus_tpu addition (no reference counterpart): each consensus group
+    participating in a cross-group atomic transaction persists its
+    participant state machine — prepared, then committed OR aborted — so a
+    restarted participant resumes knowing exactly which transactions it has
+    promised and which it has resolved.  ``groups`` names every participant
+    (the atomicity invariant's scope) and ``coordinator`` the group whose
+    coordinator drives the decision.
+    """
+
+    txid: str
+    phase: str  # one of TWOPC_PHASES
+    groups: tuple = ()
+    coordinator: str = ""
+
+
 #: The "SavedMessage oneof": anything persisted to the WAL.
-SavedMessage = Union[ProposedRecord, SavedCommit, SavedNewView, SavedViewChange]
+SavedMessage = Union[
+    ProposedRecord, SavedCommit, SavedNewView, SavedViewChange, SavedTwoPC
+]
 
 
 def msg_to_string(msg: ConsensusMessage) -> str:
@@ -406,6 +431,8 @@ __all__ = [
     "SavedCommit",
     "SavedNewView",
     "SavedViewChange",
+    "SavedTwoPC",
+    "TWOPC_PHASES",
     "SavedMessage",
     "msg_to_string",
 ]

@@ -52,6 +52,19 @@ Without it, schedules are byte-identical to pre-storage-fault sweeps.
 
     python scripts/chaos_sweep.py --start 0 --count 50 --storage-faults
 
+``--groups N`` switches the sweep to the CROSS-GROUP vocabulary
+(consensus_tpu/groups/chaos.py): every seed runs N consensus groups over
+one shared scheduler with a cross-group 2PC in flight while the
+schedule partitions participant leaders and (at most once) kills the
+coordinator mid-protocol.  A seed fails exactly when an invariant —
+including ``cross-group-atomicity`` — is violated or the groups end in
+different terminal phases.  Per-seed JSON lines carry the per-group
+resolution; ``--shrink-on-failure`` ddmins with the group-aware
+shrinker.  The sharded vocabulary replaces the single-group one, so
+``--groups`` cannot combine with the single-cluster fault flags.
+
+    python scripts/chaos_sweep.py --start 0 --count 50 --groups 2
+
 ``--mesh-shards N`` / ``--topology AxB`` route every seed's real Ed25519
 verification through the sharded mesh engines (consensus_tpu/parallel/):
 the sweep builds the engine once via ``engine_for_config`` over the
@@ -127,6 +140,67 @@ def _mesh_engine_factory(args):
         mesh_shards=topo.shard_count, mesh_topology=topo.axes
     )
     return (lambda: engine_for_config(cfg)), topo.label
+
+
+def run_groups_sweep(args) -> int:
+    """The --groups arm: cross-group 2PC chaos over the sharded vocabulary."""
+    from consensus_tpu.groups.chaos import (
+        GroupChaosEngine,
+        GroupChaosSchedule,
+        format_group_repro,
+        shrink_group_schedule,
+    )
+
+    failed: list[int] = []
+    for seed in range(args.start, args.start + args.count):
+        schedule = GroupChaosSchedule.generate(
+            seed, n_groups=args.groups, n=args.nodes, steps=args.steps
+        )
+        result = GroupChaosEngine(schedule).run()
+        print(json.dumps({
+            "seed": seed,
+            "ok": result.ok,
+            "groups": args.groups,
+            "resolution": dict(sorted(result.resolution.items())),
+            "deliveries": result.deliveries,
+        }, sort_keys=True))
+        if result.ok:
+            if args.verbose:
+                print(f"seed {seed}: ok ({result.deliveries} deliveries, "
+                      f"resolution {result.resolution})")
+            continue
+        failed.append(seed)
+        v = result.violation
+        print(f"seed {seed}: FAIL {v.invariant} at sim t={v.sim_time:.4f}")
+        print(f"  {v.detail}")
+        if args.shrink_on_failure:
+            small, shrunk_result = shrink_group_schedule(
+                schedule, invariant=v.invariant, max_runs=args.shrink_budget
+            )
+            print(f"  shrunk {len(schedule.actions)} -> "
+                  f"{len(small.actions)} actions; reproduce with:")
+            for line in format_group_repro(shrunk_result).splitlines():
+                print(f"    {line}")
+        else:
+            print("  (re-run with --shrink-on-failure for a minimal repro)")
+
+    summary = {
+        "swept": args.count,
+        "failed": len(failed),
+        "seeds_failed": failed,
+        "params": {
+            "start": args.start,
+            "groups": args.groups,
+            "nodes": args.nodes,
+            "steps": args.steps,
+        },
+    }
+    line = json.dumps(summary, sort_keys=True)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            fh.write(line + "\n")
+    return 1 if failed else 0
 
 
 def run_sweep(args) -> int:
@@ -260,6 +334,11 @@ def main() -> int:
                          "schedule's vocabulary; runs on a real "
                          "file-backed WAL with the scrubber, quarantine, "
                          "and learner-fence invariant armed")
+    ap.add_argument("--groups", type=int, default=0,
+                    help="sweep the CROSS-GROUP vocabulary instead: N "
+                         "consensus groups over one scheduler, a 2PC in "
+                         "flight, partition_leader / kill_coordinator "
+                         "actions, cross-group-atomicity invariant armed")
     ap.add_argument("--cert-mode", choices=("full", "half-agg"),
                     default="full",
                     help='quorum-cert format: "half-agg" runs every seed '
@@ -289,6 +368,14 @@ def main() -> int:
         ap.error("--mesh-shards/--topology run plain Ed25519 batch "
                  "verification and cannot be combined with "
                  "--cert-mode half-agg")
+    if args.groups:
+        if (args.churn or args.wan or args.device_faults
+                or args.storage_faults or args.mesh_shards or args.topology
+                or args.cert_mode != "full"):
+            ap.error("--groups sweeps the cross-group vocabulary and "
+                     "cannot be combined with the single-cluster fault "
+                     "flags")
+        return run_groups_sweep(args)
     return run_sweep(args)
 
 

@@ -19,6 +19,16 @@ Scenarios (consensus_tpu/ingress/workload.py):
     storm   duplicate-retry storms across the middle of the run:
             dedup_storm must fire, honest stay whole
 
+``--groups N`` runs every seed in the MULTI-GROUP shape: admitted
+requests are routed onto N consensus groups by the sharding directory
+(admit-then-route — admission happens once, exactly as in the unsharded
+run).  Per-seed lines gain ``groups`` + per-group ``group_routed``
+counts, and the verdict additionally requires every admitted request to
+have been routed to exactly one group.  Without it, per-seed lines are
+byte-identical to pre-sharding sweeps.
+
+    python scripts/ingress_sweep.py --count 20 --scenario flood --groups 3
+
 Every seed emits one JSON line:
 
     {"seed": S, "ok": true, "scenario": "flood", "offered": ...,
@@ -77,13 +87,18 @@ def run_sweep(args) -> int:
         trace = generate_trace(seed, spec)
         driver = IngressDriver(
             trace, spec, seed=seed, servers=args.servers,
-            queue_limit=args.queue_limit,
+            queue_limit=args.queue_limit, groups=args.groups,
         )
         summary = driver.run()
         for kind, k in summary["anomalies"].items():
             anomaly_totals[kind] = anomaly_totals.get(kind, 0) + k
         # The non-starvation verdict: every honest offered request admitted.
         ok = summary["admitted_honest"] == summary["offered_honest"]
+        if args.groups:
+            # Routing is total: every admitted request on exactly one group.
+            ok = ok and (
+                sum(summary["group_routed"].values()) == summary["admitted"]
+            )
         if args.scenario == "clean":
             # Clean soaks must also keep every detector silent.
             ok = ok and not summary["anomalies"]
@@ -114,6 +129,7 @@ def run_sweep(args) -> int:
             "duration": args.duration,
             "servers": args.servers,
             "queue_limit": args.queue_limit,
+            "groups": args.groups,
         },
     }
     line = json.dumps(summary_line, sort_keys=True)
@@ -140,6 +156,9 @@ def main() -> int:
                     help="simulated sidecar fleet size")
     ap.add_argument("--queue-limit", type=int, default=512,
                     help="per-server backlog bound (structured reject past it)")
+    ap.add_argument("--groups", type=int, default=0,
+                    help="route admitted requests onto N consensus groups "
+                         "(admit-then-route); 0 keeps the unsharded shape")
     ap.add_argument("--json-out", help="also write the summary line here")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="print passing seeds too")

@@ -1,0 +1,303 @@
+"""Table-driven controller message-routing edge tests.
+
+Parity model: reference internal/bft/controller_test.go routing tables —
+one named row per (message kind x replica state x sender role) cell of
+``Controller.process_message`` (controller.go:321-373 in the reference),
+asserting exactly which subsystem receives the message:
+
+* 3-phase traffic (PrePrepare/Prepare/Commit) fans out to the current
+  view AND the view changer's early-view buffer, with leader traffic
+  doubling as an artificial heartbeat;
+* view-change traffic (ViewChange/SignedViewData/NewView) goes to the
+  view changer alone;
+* heartbeats go to the leader monitor; state transfer to the collector
+  (responses) or straight back out the comm (requests);
+* a FENCED learner (quarantined WAL) drops every vote-bearing message —
+  3-phase and view-change alike — but still credits leader traffic as
+  heartbeats, and a stopped controller routes nothing at all.
+
+The harness reuses the scripted-collaborator shape of
+test_controller_sync.py with recorder stubs on every sink.
+"""
+
+import dataclasses
+
+import pytest
+
+from consensus_tpu.config import Configuration
+from consensus_tpu.core.batcher import Batcher
+from consensus_tpu.core.controller import Controller
+from consensus_tpu.core.pool import PoolOptions, RequestPool
+from consensus_tpu.core.state import InFlightData, PersistedState
+from consensus_tpu.runtime import SimScheduler
+from consensus_tpu.testing import MemWAL
+from consensus_tpu.testing.app import ByteInspector
+from consensus_tpu.testing.app import TestApp as PortsApp
+from consensus_tpu.types import Checkpoint, Proposal, Signature
+from consensus_tpu.wire import (
+    Commit,
+    HeartBeat,
+    HeartBeatResponse,
+    NewView,
+    PrePrepare,
+    Prepare,
+    SignedViewData,
+    StateTransferRequest,
+    StateTransferResponse,
+    ViewChange,
+)
+
+NODES = (1, 2, 3, 4)
+SELF = 2
+LEADER = 1  # view 0, no rotation
+
+
+class _RecordingView:
+    def __init__(self):
+        self.messages = []
+        self.stopped = False
+        self.leader_id = LEADER
+        self.proposal_sequence = 1  # view_sequence() probe for state replies
+
+    def handle_message(self, sender, msg):
+        self.messages.append((sender, msg))
+
+    def abort(self):
+        self.stopped = True
+
+
+class _RecordingVC:
+    def __init__(self):
+        self.messages = []
+        self.view_messages = []
+
+    def handle_message(self, sender, msg):
+        self.messages.append((sender, msg))
+
+    def handle_view_message(self, sender, msg):
+        self.view_messages.append((sender, msg))
+
+    def start_view_change(self, view, stop_view):
+        pass
+
+    def inform_new_view(self, view):
+        pass
+
+
+class _RecordingMonitor:
+    def __init__(self):
+        self.processed = []
+        self.injected = []
+
+    def change_role(self, role, view, leader):
+        pass
+
+    def close(self):
+        pass
+
+    def process_msg(self, sender, msg):
+        self.processed.append((sender, msg))
+
+    def inject_artificial_heartbeat(self, sender, msg):
+        self.injected.append((sender, msg))
+
+    def heartbeat_was_sent(self):
+        pass
+
+
+class _RecordingCollector:
+    def __init__(self):
+        self.responses = []
+
+    def handle_response(self, sender, msg):
+        self.responses.append((sender, msg))
+
+
+class _Harness:
+    def __init__(self):
+        self.sched = SimScheduler()
+        self.app = PortsApp(SELF, self)
+        self.sent = []
+        self.view = _RecordingView()
+        self.vc = _RecordingVC()
+        self.monitor = _RecordingMonitor()
+        self.collector = _RecordingCollector()
+        outer = self
+
+        class CommStub:
+            def send_consensus(self, target, msg):
+                outer.sent.append((target, msg))
+
+            def send_transaction(self, target, raw):
+                pass
+
+            def nodes(self):
+                return NODES
+
+        in_flight = InFlightData()
+        state = PersistedState(MemWAL([]), in_flight, entries=[])
+        pool = RequestPool(self.sched, ByteInspector(), PoolOptions())
+        self.controller = Controller(
+            scheduler=self.sched,
+            config=Configuration(
+                self_id=SELF, leader_rotation=False, decisions_per_leader=0
+            ),
+            nodes=NODES,
+            comm=CommStub(),
+            application=self.app,
+            assembler=self.app,
+            verifier=self.app,
+            signer=self.app,
+            synchronizer=None,
+            pool=pool,
+            batcher=Batcher(self.sched, pool, batch_max_count=10,
+                            batch_max_bytes=10**6, batch_max_interval=0.05),
+            leader_monitor=self.monitor,
+            collector=self.collector,
+            state=state,
+            in_flight=in_flight,
+            checkpoint=Checkpoint(),
+            proposer_builder=None,
+            view_changer=self.vc,
+        )
+        # Route straight into recorders: no real view machinery, and no
+        # Controller.start() (which would build one).  The controller boots
+        # stopped; flip the flag the way start() does.
+        self.controller._stopped = False
+        self.controller.curr_view = self.view
+        self.controller.curr_view_number = 0
+
+    # cluster duck-typing for TestApp
+    def longest_ledger(self, *, exclude):
+        return []
+
+    def sinks(self):
+        """Which recorders saw anything, as a sorted tuple of names."""
+        hit = []
+        if self.view.messages:
+            hit.append("view")
+        if self.vc.messages:
+            hit.append("vc")
+        if self.vc.view_messages:
+            hit.append("vc_early")
+        if self.monitor.processed:
+            hit.append("monitor")
+        if self.monitor.injected:
+            hit.append("heartbeat")
+        if self.collector.responses:
+            hit.append("collector")
+        if self.sent:
+            hit.append("comm")
+        return tuple(sorted(hit))
+
+
+def _pre_prepare():
+    return PrePrepare(view=0, seq=1, proposal=Proposal(payload=b"p"))
+
+
+def _prepare():
+    return Prepare(view=0, seq=1, digest="d")
+
+
+def _commit():
+    return Commit(view=0, seq=1, digest="d",
+                  signature=Signature(id=3, value=b"s", msg=b""))
+
+
+#: The routing table.  Each row: name, message factory, sender, replica
+#: state ("normal" | "fenced" | "degraded_wal" | "stopped"), expected
+#: sinks (sorted tuple of recorder names).
+ROUTING_TABLE = [
+    ("pre_prepare_from_leader_fans_out_and_heartbeats",
+     _pre_prepare, LEADER, "normal", ("heartbeat", "vc_early", "view")),
+    ("prepare_from_follower_fans_out_no_heartbeat",
+     _prepare, 3, "normal", ("vc_early", "view")),
+    ("commit_from_leader_fans_out_and_heartbeats",
+     _commit, LEADER, "normal", ("heartbeat", "vc_early", "view")),
+    ("commit_from_follower_no_heartbeat",
+     _commit, 3, "normal", ("vc_early", "view")),
+    ("view_change_goes_to_view_changer_only",
+     lambda: ViewChange(next_view=1), 3, "normal", ("vc",)),
+    ("signed_view_data_goes_to_view_changer_only",
+     lambda: SignedViewData(raw_view_data=b"vd", signer=3, signature=b"s"),
+     3, "normal", ("vc",)),
+    ("new_view_goes_to_view_changer_only",
+     lambda: NewView(), LEADER, "normal", ("vc",)),
+    ("heartbeat_goes_to_monitor",
+     lambda: HeartBeat(view=0, seq=1), LEADER, "normal", ("monitor",)),
+    ("heartbeat_response_goes_to_monitor",
+     lambda: HeartBeatResponse(view=0), 3, "normal", ("monitor",)),
+    ("state_request_answered_on_comm",
+     lambda: StateTransferRequest(), 3, "normal", ("comm",)),
+    ("state_response_goes_to_collector",
+     lambda: StateTransferResponse(view_num=0, sequence=1), 3, "normal",
+     ("collector",)),
+    # Fenced learner: vote-bearing traffic is dropped entirely...
+    ("fenced_drops_commit_from_follower",
+     _commit, 3, "fenced", ()),
+    ("fenced_drops_view_change",
+     lambda: ViewChange(next_view=1), 3, "fenced", ()),
+    # ...except leader 3-phase traffic still counts as a heartbeat.
+    ("fenced_leader_pre_prepare_credits_heartbeat_only",
+     _pre_prepare, LEADER, "fenced", ("heartbeat",)),
+    # A WAL refusing appends (ENOSPC) suspends voting the same way.
+    ("degraded_wal_drops_prepare",
+     _prepare, 3, "degraded_wal", ()),
+    ("degraded_wal_still_routes_heartbeats",
+     lambda: HeartBeat(view=0, seq=1), LEADER, "degraded_wal", ("monitor",)),
+    # A stopped controller routes NOTHING, whatever the message.
+    ("stopped_drops_pre_prepare",
+     _pre_prepare, LEADER, "stopped", ()),
+    ("stopped_drops_heartbeat",
+     lambda: HeartBeat(view=0, seq=1), LEADER, "stopped", ()),
+    ("stopped_drops_state_request",
+     lambda: StateTransferRequest(), 3, "stopped", ()),
+]
+
+
+@pytest.mark.parametrize(
+    "name,factory,sender,state,expected",
+    ROUTING_TABLE,
+    ids=[row[0] for row in ROUTING_TABLE],
+)
+def test_process_message_routing(name, factory, sender, state, expected):
+    h = _Harness()
+    if state == "fenced":
+        h.controller.fence_as_learner(0)
+    elif state == "degraded_wal":
+        h.controller.set_wal_degraded(True)
+    elif state == "stopped":
+        h.controller._stopped = True
+    h.controller.process_message(sender, factory())
+    assert h.sinks() == expected
+
+
+def test_unknown_message_routes_nowhere(caplog):
+    import logging
+
+    @dataclasses.dataclass(frozen=True)
+    class Mystery:
+        blob: bytes = b"?"
+
+    h = _Harness()
+    with caplog.at_level(logging.WARNING, logger="consensus_tpu.controller"):
+        h.controller.process_message(3, Mystery())
+    assert h.sinks() == ()
+    assert any("unknown message" in r.message for r in caplog.records)
+
+
+def test_three_phase_payload_reaches_view_verbatim():
+    h = _Harness()
+    msg = _prepare()
+    h.controller.process_message(3, msg)
+    assert h.view.messages == [(3, msg)]
+    assert h.vc.view_messages == [(3, msg)]
+
+
+def test_state_request_reply_carries_current_view_and_sequence():
+    h = _Harness()
+    h.controller.process_message(3, StateTransferRequest())
+    (target, reply), = h.sent
+    assert target == 3
+    assert isinstance(reply, StateTransferResponse)
+    assert reply.view_num == h.controller.curr_view_number

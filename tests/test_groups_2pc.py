@@ -1,0 +1,202 @@
+"""Cross-group atomic commits (consensus_tpu/groups/twopc.py + chaos.py):
+the happy path, restart realism (WAL replay), coordinator death +
+presumed-abort recovery, seeded per-group chaos mid-2PC, and the sentinel
+gate — a planted one-sided commit the atomicity invariant must catch and
+ddmin must shrink to a minimal (here: empty) action set.
+"""
+
+import pytest
+
+from consensus_tpu.groups.chaos import (
+    GroupChaosEngine,
+    GroupChaosSchedule,
+    format_group_repro,
+    shrink_group_schedule,
+)
+from consensus_tpu.groups.cluster import ShardedCluster
+from consensus_tpu.groups.twopc import TwoPhaseCoordinator, TwoPhaseParticipant
+from consensus_tpu.metrics import (
+    GROUPS_TWOPC_ABORTED_KEY,
+    GROUPS_TWOPC_COMMITTED_KEY,
+    GROUPS_TWOPC_STARTED_KEY,
+    InMemoryProvider,
+    Metrics,
+)
+from consensus_tpu.wire import SavedTwoPC, decode_saved
+
+# --- the happy path ---------------------------------------------------------
+
+
+def test_cross_group_commit_happy_path():
+    metrics = Metrics(InMemoryProvider())
+    shard = ShardedCluster(2, n=4, seed=1, metrics=metrics)
+    shard.start()
+    for t in range(6):
+        shard.submit(f"tenant-{t}")
+    assert shard.run_until_heights(1)
+
+    txid = "tx-happy"
+    shard.coordinator.start(txid, shard.group_ids())
+    assert shard.run_until(lambda: shard.coordinator.all_prepared(txid))
+    # Prepared is a replicated, ordered fact — the detector health field
+    # exposes the open transaction's age until resolution...
+    assert "groups_twopc_oldest_age" in shard.health_fields()
+    assert shard.coordinator.decide(txid) == "commit"
+    assert shard.run_until(
+        lambda: shard.registry.resolved(txid) == "committed"
+    )
+    # ...and clears the moment every group reaches the same terminal phase.
+    assert shard.health_fields() == {}
+    shard.assert_clean()
+    for gid in shard.group_ids():
+        assert shard.participants[gid].state[txid] == "committed"
+        assert shard.participants[gid].errors == []
+    dump = metrics.provider.dump()
+    assert dump[GROUPS_TWOPC_STARTED_KEY]["value"] == 1.0
+    assert dump[GROUPS_TWOPC_COMMITTED_KEY]["value"] == 1.0
+    assert dump[GROUPS_TWOPC_ABORTED_KEY]["value"] == 0.0
+
+
+def test_participant_wal_replay_rebuilds_state():
+    """Restart realism: a fresh participant fed the persisted SavedTwoPC
+    records lands in the same terminal state."""
+    shard = ShardedCluster(2, n=4, seed=3)
+    shard.start()
+    txid = "tx-replay"
+    shard.coordinator.start(txid, shard.group_ids())
+    assert shard.run_until(lambda: shard.coordinator.all_prepared(txid))
+    shard.coordinator.decide(txid)
+    assert shard.run_until(
+        lambda: shard.registry.resolved(txid) == "committed"
+    )
+    for gid in shard.group_ids():
+        entries = shard.participants[gid].wal.entries
+        phases = [decode_saved(e).phase for e in entries
+                  if isinstance(decode_saved(e), SavedTwoPC)]
+        assert phases == ["prepared", "committed"]
+        reborn = TwoPhaseParticipant(gid)
+        reborn.replay(entries)
+        assert reborn.state[txid] == "committed"
+
+
+def test_coordinator_death_resolves_by_presumed_abort():
+    """kill -9 before the decision: recovery reads the replicated
+    participant states, finds no commit anywhere, aborts everywhere —
+    and both groups agree."""
+    shard = ShardedCluster(2, n=4, seed=8)
+    shard.start()
+    txid = "tx-orphan"
+    shard.coordinator.start(txid, shard.group_ids())
+    assert shard.run_until(lambda: shard.coordinator.all_prepared(txid))
+    shard.coordinator.kill()
+    assert shard.coordinator.decide(txid) is None  # dead: silent no-op
+
+    outcome = TwoPhaseCoordinator.recover(shard.groups, shard.registry, txid)
+    assert outcome == "abort"
+    assert shard.run_until(
+        lambda: shard.registry.resolved(txid) == "aborted"
+    )
+    shard.assert_clean()
+    # Recovery is idempotent: running it again changes nothing.
+    assert TwoPhaseCoordinator.recover(
+        shard.groups, shard.registry, txid
+    ) == "abort"
+    assert shard.registry.resolved(txid) == "aborted"
+
+
+# --- seeded chaos mid-2PC ---------------------------------------------------
+
+#: Both pinned seeds produce schedules containing kill_coordinator AND
+#: partition_leader (verified at pin time; generation is deterministic).
+CHAOS_SEEDS = (5, 22)
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_cross_group_2pc_survives_chaos(seed):
+    schedule = GroupChaosSchedule.generate(seed, steps=6)
+    kinds = {a.kind for a in schedule.actions}
+    assert {"kill_coordinator", "partition_leader"} <= kinds, kinds
+    result = GroupChaosEngine(schedule).run()
+    assert result.ok, format_group_repro(result)
+    # Both participant groups reached the SAME terminal phase.
+    phases = set(result.resolution.values())
+    assert len(phases) == 1 and phases <= {"committed", "aborted"}
+    # A killed coordinator forces the presumed-abort path.
+    assert result.resolution["group-0"] == "aborted"
+    assert b"recovery decide abort" in result.event_log
+
+
+def test_honest_chaos_runs_are_silent_and_deterministic():
+    """No planted bug: generated schedules pass, and the same seed replays
+    to the identical event log + ledgers."""
+    schedule = GroupChaosSchedule.generate(3, steps=5)
+    a = GroupChaosEngine(schedule).run()
+    b = GroupChaosEngine(schedule).run()
+    assert a.ok and b.ok
+    assert a.event_log == b.event_log
+    assert a.ledgers == b.ledgers
+    assert a.resolution == b.resolution
+
+
+# --- the sentinel gate ------------------------------------------------------
+
+
+def test_one_sided_commit_sentinel_is_caught_and_shrinks():
+    """The planted coordinator bug (commit to one group, abort to the
+    other) must be flagged as a cross-group-atomicity violation at
+    delivery time, and ddmin must shrink the schedule to <= 3 actions
+    (the sentinel needs none)."""
+    # Seed 3's schedule has no kill_coordinator: the coordinator stays
+    # alive to execute its planted one-sided decision.
+    schedule = GroupChaosSchedule.generate(3, steps=5)
+    assert all(a.kind != "kill_coordinator" for a in schedule.actions)
+    engine_kwargs = {"sentinel_one_sided": True}
+    result = GroupChaosEngine(schedule, **engine_kwargs).run()
+    assert not result.ok
+    assert result.violation.invariant == "cross-group-atomicity"
+    assert "committed" in result.violation.detail
+    assert set(result.resolution.values()) == {"committed", "aborted"}
+
+    shrunk, shrunk_res = shrink_group_schedule(
+        schedule,
+        invariant="cross-group-atomicity",
+        engine_kwargs=engine_kwargs,
+    )
+    assert len(shrunk.actions) <= 3
+    assert shrunk_res.violation.invariant == "cross-group-atomicity"
+    repro = format_group_repro(shrunk_res)
+    assert "GroupChaosSchedule(" in repro and "seed=3" in repro
+
+
+def test_cross_group_stall_detector_fires_on_unresolved_twopc():
+    """The obs plane's end-to-end path: an unresolved transaction ages the
+    groups_twopc_oldest_age health field past the window and the
+    cross_group_stall detector fires (edge-triggered), then clears."""
+    from consensus_tpu.obs.detectors import DetectorBank
+
+    shard = ShardedCluster(2, n=4, seed=4)
+    shard.start()
+    txid = "tx-stalled"
+    shard.coordinator.start(txid, shard.group_ids())
+    assert shard.run_until(lambda: shard.coordinator.all_prepared(txid))
+
+    bank = DetectorBank()
+    base = shard.scheduler.now()
+    fired = []
+    for i in range(3):
+        shard.scheduler.advance(40.0)
+        health = {"running": True, "ledger": 1, "pool": 0}
+        health.update(shard.health_fields())
+        fired += bank.evaluate(base + 40.0 * (i + 1), {0: health})
+    kinds = [a.kind for a in fired]
+    assert kinds.count("cross_group_stall") == 1  # edge-triggered latch
+
+    # Resolve; the health field disappears and the latch clears.
+    shard.coordinator.decide(txid)
+    assert shard.run_until(
+        lambda: shard.registry.resolved(txid) is not None
+    )
+    assert shard.health_fields() == {}
+    health = {"running": True, "ledger": 1, "pool": 0}
+    more = bank.evaluate(base + 500.0, {0: health})
+    assert all(a.kind != "cross_group_stall" for a in more)

@@ -208,3 +208,23 @@ def test_lint_covers_storage_fault_layer():
             f"storage-fault tree {root} has wall-clock reads:\n"
             + proc.stdout + proc.stderr
         )
+
+
+def test_lint_covers_groups_plane():
+    """The sharding plane promises per-group ledgers byte-identical to
+    standalone same-seed clusters and deterministic chaos replays — a
+    wall-clock read anywhere in consensus_tpu/groups/ (directory scores,
+    2PC ages, chaos gap derivation) would break both.  Pin the lint's
+    coverage of the tree, presence of the expected modules first."""
+    groups_dir = os.path.join(_REPO, "consensus_tpu", "groups")
+    present = {f for f in os.listdir(groups_dir) if f.endswith(".py")}
+    assert {"directory.py", "router.py", "cluster.py",
+            "twopc.py", "chaos.py", "deploy.py"} <= present
+    proc = subprocess.run(
+        [sys.executable, _SCRIPT, groups_dir],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (
+        "groups plane has wall-clock reads:\n" + proc.stdout + proc.stderr
+    )

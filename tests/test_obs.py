@@ -205,8 +205,11 @@ def test_leader_churn_schedule_fires_storm_and_flap_detectors():
     # tests/test_obs.py wal-detector units + tests/test_storage_faults.py
     # fire the storage pair end-to-end.
     storage_kinds = {"wal_corruption", "wal_stall"}
+    # tests/test_groups_2pc.py fires cross_group_stall end-to-end.
+    groups_kinds = {"cross_group_stall"}
     assert (partition_kinds | churn_kinds | ingress_kinds | engine_kinds
-            | storage_kinds | set(counts) >= set(ANOMALY_KINDS))
+            | storage_kinds | groups_kinds
+            | set(counts) >= set(ANOMALY_KINDS))
 
 
 def test_wal_corruption_and_stall_detectors_edge_trigger():
