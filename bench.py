@@ -31,6 +31,16 @@ BATCH = 16384
 DEVICE_ITERS = 5
 HOST_SAMPLE = 512
 
+#: The ``mxu_limbs`` family (VPU-vs-MXU field-arithmetic A/B): chain length
+#: of the timed ``lax.scan`` multiplication loop, the batch sweep, timed
+#: iterations, and the randomized-verify batch that exercises the Straus/MSM
+#: Pallas kernel end to end.  The MSM batch is env-tunable because interpret
+#: mode (CPU backends) pays a large constant per tile.
+MXU_CHAIN = 64
+MXU_BATCH_SWEEP = (512, 4096)
+MXU_CHAIN_ITERS = 5
+MXU_MSM_BATCH = int(os.environ.get("CTPU_BENCH_MSM_BATCH", "256"))
+
 #: Machine-readable measurement trail: refreshed after every successful live
 #: run, reported (with ``stale: true``) when the device is unreachable, so
 #: the BENCH_r* artifact chain never loses the last good number to a wedged
@@ -1071,6 +1081,248 @@ def bench_groups_main() -> int:
     return 0
 
 
+def _mxu_field_cell(curve: str, batch: int) -> dict:
+    """One A/B cell of the ``mxu_limbs`` family: a ``MXU_CHAIN``-deep field
+    multiplication chain over ``batch`` lanes, compiled FRESH for each lane
+    (the lane is chosen at trace time, so reusing one jit cache would
+    silently time the first lane's graph twice).  Returns per-lane rates and
+    XLA cost-analysis estimates, and raises if the lanes' outputs are not
+    bit-identical — parity is the MXU lane's contract, a fast divergent
+    kernel is not a result."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensus_tpu.obs.kernels import _cost_number
+    from consensus_tpu.ops import mxu_limbs
+
+    if curve == "ed25519":
+        from consensus_tpu.ops import field25519 as field
+    else:
+        from consensus_tpu.ops import field_p256 as field
+
+    def chain(a, b):
+        def step(acc, _):
+            return field.mul(acc, b), None
+
+        out, _ = jax.lax.scan(step, a, None, length=MXU_CHAIN)
+        return out
+
+    ka, kb = jax.random.split(jax.random.PRNGKey(batch))
+    a = jax.random.randint(ka, (32, batch), 0, 256).astype(jnp.float32)
+    b = jax.random.randint(kb, (32, batch), 0, 256).astype(jnp.float32)
+
+    cell = {}
+    outs = {}
+    for lane, ctx in (
+        ("vpu", mxu_limbs.suppress_mxu_limbs),
+        ("mxu", mxu_limbs.force_mxu_limbs),
+    ):
+        with ctx():
+            jitted = jax.jit(lambda x, y: chain(x, y))
+            analysis = jitted.lower(a, b).cost_analysis()
+            out = jax.block_until_ready(jitted(a, b))  # compile + warm
+            start = time.perf_counter()
+            for _ in range(MXU_CHAIN_ITERS):
+                out = jitted(a, b)
+            jax.block_until_ready(out)
+            elapsed = time.perf_counter() - start
+        outs[lane] = np.asarray(out)
+        cell[lane] = {
+            "field_muls_per_sec": round(
+                batch * MXU_CHAIN * MXU_CHAIN_ITERS / elapsed, 1
+            ),
+            "flops": _cost_number(analysis, "flops"),
+            "bytes_accessed": _cost_number(analysis, "bytes accessed"),
+        }
+    cell["parity"] = bool(np.array_equal(outs["vpu"], outs["mxu"]))
+    if not cell["parity"]:
+        raise RuntimeError(
+            f"MXU lane diverged from VPU limbs for {curve}@{batch}: the "
+            "lanes must be bit-identical, a fast wrong kernel is not a result"
+        )
+    vpu_rate = cell["vpu"]["field_muls_per_sec"]
+    cell["mxu_vs_vpu"] = round(
+        cell["mxu"]["field_muls_per_sec"] / vpu_rate, 3
+    ) if vpu_rate else 0.0
+    return cell
+
+
+def _mxu_msm_sigs(n: int):
+    """``n`` honest signatures from the pure-python signer — no dependence
+    on the ``cryptography`` package, so the MSM A/B runs anywhere jax does."""
+    from consensus_tpu.models.verifier import Ed25519Signer
+
+    signers = [Ed25519Signer(i, bytes([i + 1] * 32)) for i in range(8)]
+    msgs, sigs, keys = [], [], []
+    for i in range(n):
+        s = signers[i % len(signers)]
+        m = b"mxu-msm-%d" % i
+        msgs.append(m)
+        sigs.append(s.sign_raw(m))
+        keys.append(s.public_bytes)
+    return msgs, sigs, keys
+
+
+def _mxu_msm_cell(batch: int) -> dict:
+    """End-to-end randomized batch verify through the Straus/MSM Pallas
+    kernel: VPU lane vs MXU lane (which routes the shared MSM into the
+    VMEM-resident kernel), fresh-jit per lane via the same module-attribute
+    monkeypatch the Pallas tests use.  Two parts: a small forged-signature
+    parity probe (verdict vectors must match bit for bit, forgery rejected),
+    then an all-valid throughput measurement at ``batch``."""
+    import jax
+    import numpy as np
+
+    from consensus_tpu.models import ed25519 as model
+    from consensus_tpu.ops import mxu_limbs
+
+    msgs, sigs, keys = _mxu_msm_sigs(batch)
+    p_msgs, p_sigs, p_keys = _mxu_msm_sigs(16)
+    p_sigs[3] = bytes(64)  # forged: parity must hold through bisection
+
+    verifier = model.Ed25519RandomizedBatchVerifier(min_device_batch=2)
+    cell = {"batch": batch}
+    verdicts = {}
+    saved = model._batch_verify_kernel
+    saved_strict = model._verify_kernel
+    for lane, ctx in (
+        ("vpu", mxu_limbs.suppress_mxu_limbs),
+        ("mxu", mxu_limbs.force_mxu_limbs),
+    ):
+        try:
+            with ctx():
+                # Fresh lambda per lane: jit of the bare module function
+                # would hit the trace cache (keyed on function identity +
+                # avals) and replay the first lane's graph — the A/B would
+                # time the same kernel twice.  Same for the strict kernel
+                # the bisection's sub-verifies fall back to.
+                model._batch_verify_kernel = jax.jit(
+                    lambda *a: model.batch_verify_impl(*a)
+                )
+                model._verify_kernel = jax.jit(
+                    lambda *a: model.verify_impl(*a)
+                )
+                probe = verifier.verify_batch(p_msgs, p_sigs, p_keys)
+                verifier.verify_batch(msgs, sigs, keys)  # compile + warm
+                start = time.perf_counter()
+                ok = verifier.verify_batch(msgs, sigs, keys)
+                elapsed = time.perf_counter() - start
+        finally:
+            model._batch_verify_kernel = saved
+            model._verify_kernel = saved_strict
+        verdicts[lane] = (np.asarray(probe), np.asarray(ok))
+        cell[lane] = {"sigs_per_sec": round(batch / elapsed, 1)}
+    cell["verdict_parity"] = bool(
+        np.array_equal(verdicts["vpu"][0], verdicts["mxu"][0])
+        and np.array_equal(verdicts["vpu"][1], verdicts["mxu"][1])
+    )
+    cell["forged_rejected"] = bool(not verdicts["mxu"][0][3])
+    if not (cell["verdict_parity"] and cell["forged_rejected"]):
+        raise RuntimeError(
+            f"MSM verdict gate failed: {cell} — the MXU MSM lane must "
+            "reproduce the VPU lane's verdict vector bit for bit"
+        )
+    return cell
+
+
+def bench_mxu_limbs_main() -> int:
+    """The ``mxu_limbs`` family: live device A/B of the MXU field lane
+    (``CTPU_MXU_LIMBS=1`` semantics, forced in-process per trace) against
+    the VPU limb stack — both curves, a batch sweep, plus the Straus/MSM
+    Pallas kernel end to end.  A Mosaic/lowering failure on any cell is a
+    RECORDED negative result (the cell's error string lands in the JSON);
+    silence is the only unacceptable outcome.  Same structured-skip +
+    last-good trail discipline as the other device families."""
+    metric = "mxu_limbs_fieldmul_throughput"
+    probe_ok, probe_attempts = _probe_device_with_retries()
+    if not probe_ok:
+        last_good = _load_last_good(metric)
+        print(json.dumps({
+            "metric": metric,
+            "skipped": "device-unavailable",
+            "detail": "device unreachable (TPU tunnel wedged; "
+                      f"retried for {RETRY_WINDOW:.0f}s)",
+            "attempts": probe_attempts,
+            "last_good": dict(last_good, stale=True) if last_good else None,
+        }))
+        return 0
+
+    import jax
+
+    backend = jax.default_backend()
+    by_cell = {}
+    errors = {}
+    for curve in ("ed25519", "p256"):
+        for batch in MXU_BATCH_SWEEP:
+            name = f"{curve}@{batch}"
+            try:
+                by_cell[name] = _mxu_field_cell(curve, batch)
+            except Exception as exc:  # noqa: BLE001 — recorded, not silent
+                errors[name] = repr(exc)
+    try:
+        msm = _mxu_msm_cell(MXU_MSM_BATCH)
+    except Exception as exc:  # noqa: BLE001 — recorded, not silent
+        msm = {"error": repr(exc)}
+
+    headline = f"ed25519@{MXU_BATCH_SWEEP[-1]}"
+    if headline not in by_cell:
+        last_good = _load_last_good(metric)
+        print(json.dumps({
+            "metric": metric,
+            "skipped": "mxu-lane-error",
+            "detail": errors.get(headline, "headline cell missing"),
+            "backend": backend,
+            "by_cell": by_cell,
+            "errors": errors,
+            "msm_verify": msm,
+            "last_good": dict(last_good, stale=True) if last_good else None,
+        }))
+        return 0
+    head = by_cell[headline]
+    record = {
+        "metric": metric,
+        "value": head["mxu"]["field_muls_per_sec"],
+        "unit": "field_muls/sec",
+        "vs_baseline": head["mxu_vs_vpu"],
+        "backend": backend,
+        "chain": MXU_CHAIN,
+        "by_cell": by_cell,
+        "msm_verify": msm,
+    }
+    if errors:
+        record["errors"] = errors
+    # A CPU smoke of this family must not impersonate a device trail: the
+    # last-good hardware tag follows the backend that produced the number.
+    hardware = "v5e-1 via tunnel" if backend != "cpu" else "host (cpu backend)"
+    _save_last_good(
+        metric, record["value"], record["vs_baseline"],
+        unit="field_muls/sec", hardware=hardware,
+    )
+    if "mxu" in msm:
+        _save_last_good(
+            "mxu_limbs_msm_verify_throughput",
+            msm["mxu"]["sigs_per_sec"],
+            msm["mxu"]["sigs_per_sec"] / msm["vpu"]["sigs_per_sec"],
+            hardware=hardware,
+        )
+    print(json.dumps(record))
+    print(
+        f"# mxu_limbs backend={backend} "
+        f"{headline} mxu={head['mxu']['field_muls_per_sec']:.0f} "
+        f"vpu={head['vpu']['field_muls_per_sec']:.0f} field-muls/s "
+        f"({head['mxu_vs_vpu']:.2f}x), "
+        + (
+            f"msm {msm['mxu']['sigs_per_sec']:.0f} vs "
+            f"{msm['vpu']['sigs_per_sec']:.0f} sigs/s"
+            if "mxu" in msm
+            else f"msm error: {msm.get('error')}"
+        ),
+        file=sys.stderr,
+    )
+    return 0
+
+
 def main() -> None:
     from __graft_entry__ import _enable_compile_cache
 
@@ -1088,6 +1340,10 @@ def main() -> None:
     if family == "groups":
         # Host-side family: sharded groups over one shared wave former.
         sys.exit(bench_groups_main())
+    if family == "mxu_limbs":
+        # Device family with its own probe/skip handling: the VPU-vs-MXU
+        # field-arithmetic A/B (both curves, batch sweep, MSM kernel).
+        sys.exit(bench_mxu_limbs_main())
     metric = {
         "p256": "ecdsa_p256_verify_throughput",
         "cert_verify": "cert_verify_throughput",
@@ -1097,6 +1353,11 @@ def main() -> None:
         # its own key — it must never overwrite the headline last-good
         # number with an A/B experiment's result.
         metric += "_pallas"
+    if os.environ.get("CTPU_MXU_LIMBS") == "1":
+        # Same discipline for the MXU field-arithmetic lane: an A/B run
+        # must never overwrite the headline VPU trail (the kernel ledger
+        # keys get the matching suffix via obs.kernels.kernel_lane_suffix).
+        metric += "_mxu"
     probe_ok, probe_attempts = _probe_device_with_retries()
     if not probe_ok:
         # A wedged TPU tunnel is an infrastructure condition, not a

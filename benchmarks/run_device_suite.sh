@@ -86,4 +86,15 @@ record bench_p256_pallas env CTPU_PALLAS_SCAN=1 timeout -k 10 1800 \
 record_stream mxu_fieldmul timeout -k 10 1200 \
   python benchmarks/mxu_fieldmul.py --batch 8192 --iters 30
 
+# Priority 7: the MXU field-arithmetic lane (CTPU_MXU_LIMBS=1) — first the
+# dedicated A/B family (VPU vs MXU limb products, both curves, batch sweep,
+# plus the VMEM-resident Straus/MSM Pallas kernel end to end; any Mosaic
+# lowering failure lands as a recorded per-cell error in the JSON), then
+# the full headline bench under the lane (trails under *_mxu keys, never
+# overwriting the headline VPU numbers).
+record_stream mxu_limbs timeout -k 10 1800 \
+  python bench.py mxu_limbs
+record bench_ed25519_mxu env CTPU_MXU_LIMBS=1 timeout -k 10 1800 \
+  python bench.py
+
 note "device suite done -> $OUT"

@@ -35,7 +35,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from consensus_tpu.obs.kernels import instrumented_jit
+from consensus_tpu.obs.kernels import instrumented_jit, kernel_lane_suffix
 from consensus_tpu.ops import field25519 as fe
 
 from consensus_tpu.models.ed25519 import (
@@ -68,7 +68,7 @@ _HALFAGG_TAG = b"ctpu/halfagg/v1"
 #: own name: the "exactly one MSM launch per aggregate cert" gate reads
 #: this counter without PR-6 batch_verify traffic polluting it.
 _halfagg_verify_kernel = instrumented_jit(
-    batch_verify_impl, "ed25519.halfagg_verify"
+    batch_verify_impl, "ed25519.halfagg_verify" + kernel_lane_suffix()
 )
 
 

@@ -27,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from consensus_tpu.obs.kernels import instrumented_jit
+from consensus_tpu.obs.kernels import instrumented_jit, kernel_lane_suffix
 
 from consensus_tpu.models.ed25519 import _next_pow2
 from consensus_tpu.ops import field_p256 as fp
@@ -159,7 +159,9 @@ def verify_impl(
     return host_ok & q_ok & nonzero & (match1 | match2)
 
 
-_verify_kernel = instrumented_jit(verify_impl, "ecdsa_p256.verify")
+_verify_kernel = instrumented_jit(
+    verify_impl, "ecdsa_p256.verify" + kernel_lane_suffix()
+)
 
 
 def pad_prepared(prepped, padded: int):

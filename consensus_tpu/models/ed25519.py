@@ -30,7 +30,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from consensus_tpu.obs.kernels import instrumented_jit
+from consensus_tpu.obs.kernels import instrumented_jit, kernel_lane_suffix
 
 from consensus_tpu.ops import ed25519 as ed
 from consensus_tpu.ops import field25519 as fe
@@ -162,7 +162,9 @@ def verify_impl(
     return host_ok & r_ok & a_ok & ed.equal(acc, r_point)
 
 
-_verify_kernel = instrumented_jit(verify_impl, "ed25519.verify")
+_verify_kernel = instrumented_jit(
+    verify_impl, "ed25519.verify" + kernel_lane_suffix()
+)
 
 
 _P_BYTES_BE = np.frombuffer(fe.P.to_bytes(32, "big"), dtype=np.uint8)
@@ -571,7 +573,9 @@ def batch_verify_impl(
     return ed.is_identity(acc)[0], valid
 
 
-_batch_verify_kernel = instrumented_jit(batch_verify_impl, "ed25519.batch_verify")
+_batch_verify_kernel = instrumented_jit(
+    batch_verify_impl, "ed25519.batch_verify" + kernel_lane_suffix()
+)
 
 
 def _ref_negate(p):

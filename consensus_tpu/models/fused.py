@@ -50,7 +50,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from consensus_tpu.obs.kernels import instrumented_jit
+from consensus_tpu.obs.kernels import instrumented_jit, kernel_lane_suffix
 from consensus_tpu.ops import field25519 as fe
 from consensus_tpu.ops import scalar25519 as sc
 from consensus_tpu.ops import sha512 as sh
@@ -184,7 +184,9 @@ def fused_verify_impl(
 def _fused_verify_kernel():
     donate = (2,) if jax.default_backend() != "cpu" else ()
     return instrumented_jit(
-        fused_verify_impl, "ed25519.fused_verify", donate_argnums=donate
+        fused_verify_impl,
+        "ed25519.fused_verify" + kernel_lane_suffix(),
+        donate_argnums=donate,
     )
 
 
@@ -523,7 +525,7 @@ class FusedEd25519RandomizedBatchVerifier(
         """One fused aggregate check over the subset ``idx`` — the seam the
         sharded engine overrides with its mesh launch."""
         return fused_aggregate_check(
-            name="ed25519.fused_batch_verify",
+            name="ed25519.fused_batch_verify" + kernel_lane_suffix(),
             tag=_Z_TAG,
             messages=[messages[i] for i in idx],
             rs=[bytes(signatures[i])[:32] for i in idx],

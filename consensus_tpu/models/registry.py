@@ -1,5 +1,5 @@
-"""First-class engine registry: ``(curve, mode, topology, device_prep)`` ->
-batch-engine builder.
+"""First-class engine registry: ``(curve, mode, topology, device_prep,
+mxu)`` -> batch-engine builder.
 
 ``engine_for_config``'s routing used to be an if-ladder over four
 orthogonal knobs; every new axis (curves, randomized lanes, fused
@@ -18,6 +18,7 @@ importable on boxes without the accelerator stack.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
@@ -48,6 +49,12 @@ class EngineKey:
     mode: str = "strict"
     topology: str = "single"
     device_prep: bool = False
+    #: The MXU field-arithmetic lane (``CTPU_MXU_LIMBS=1``).  Env-derived
+    #: only — the lane is selected at trace time by the environment, so a
+    #: config knob would let key and traced graph disagree; the key axis
+    #: exists so the registry can refuse cells the lane does not cover
+    #: (P-256 has no MXU MSM) instead of silently falling back.
+    mxu: bool = False
 
     def __post_init__(self) -> None:
         if self.mode not in MODES:
@@ -99,6 +106,13 @@ class EngineRegistry:
     def _missing_reason(self, key: EngineKey) -> str:
         if key.curve not in self.curves():
             return f"unknown curve {key.curve!r}"
+        if key.mxu and key.curve != "ed25519":
+            return (
+                "CTPU_MXU_LIMBS engines are Ed25519-only: P-256 has no MXU "
+                "Straus/MSM kernel yet, and building a P-256 engine under "
+                "an MXU key would silently run a half-MXU lane the A/B "
+                "never measured — unset CTPU_MXU_LIMBS for P-256 engines"
+            )
         if key.curve == "p256" and key.mode == "randomized":
             return "batch_verify_mode is Ed25519-only (no randomized P-256 lane)"
         if key.curve == "p256" and key.device_prep:
@@ -141,7 +155,24 @@ class EngineRegistry:
 # so those cells stay UNREGISTERED and lookups explain why.
 
 
-def _ed25519_single(topology, compile_cache, *, randomized, fused, **kw):
+def _require_mxu_lane() -> None:
+    """A builder registered under an ``mxu=True`` key promises a graph the
+    process only traces when the environment selects the lane — building
+    it without ``CTPU_MXU_LIMBS=1`` would hand back a silently-VPU engine
+    under an MXU label, exactly the mislabeled A/B the registry exists to
+    prevent."""
+    if os.environ.get("CTPU_MXU_LIMBS", "") != "1":
+        raise RuntimeError(
+            "EngineKey.mxu=True but CTPU_MXU_LIMBS is not '1': the MXU "
+            "lane is selected by the environment at trace time, so this "
+            "build would trace the VPU lane under an MXU key — set "
+            "CTPU_MXU_LIMBS=1 in the process environment first"
+        )
+
+
+def _ed25519_single(topology, compile_cache, *, randomized, fused, mxu=False, **kw):
+    if mxu:
+        _require_mxu_lane()
     if fused:
         from consensus_tpu.models.fused import (
             FusedEd25519BatchVerifier,
@@ -165,7 +196,9 @@ def _ed25519_single(topology, compile_cache, *, randomized, fused, **kw):
     return cls(**kw)
 
 
-def _ed25519_mesh(topology, compile_cache, *, randomized, fused, **kw):
+def _ed25519_mesh(topology, compile_cache, *, randomized, fused, mxu=False, **kw):
+    if mxu:
+        _require_mxu_lane()
     from consensus_tpu.parallel import sharding
 
     cls = {
@@ -195,15 +228,24 @@ def _default_registry() -> EngineRegistry:
     reg = EngineRegistry()
     for mode in MODES:
         for fused in (False, True):
-            randomized = mode == "randomized"
-            reg.register(
-                EngineKey("ed25519", mode, "single", fused),
-                partial(_ed25519_single, randomized=randomized, fused=fused),
-            )
-            reg.register(
-                EngineKey("ed25519", mode, "mesh", fused),
-                partial(_ed25519_mesh, randomized=randomized, fused=fused),
-            )
+            for mxu in (False, True):
+                randomized = mode == "randomized"
+                reg.register(
+                    EngineKey("ed25519", mode, "single", fused, mxu),
+                    partial(
+                        _ed25519_single,
+                        randomized=randomized, fused=fused, mxu=mxu,
+                    ),
+                )
+                reg.register(
+                    EngineKey("ed25519", mode, "mesh", fused, mxu),
+                    partial(
+                        _ed25519_mesh,
+                        randomized=randomized, fused=fused, mxu=mxu,
+                    ),
+                )
+    # p256 x mxu stays UNREGISTERED (no MXU MSM for P-256);
+    # _missing_reason names the refusal.
     reg.register(EngineKey("p256", "strict", "single", False), _p256_single)
     reg.register(EngineKey("p256", "strict", "mesh", False), _p256_mesh)
     return reg
@@ -228,6 +270,10 @@ def engine_key_for(config, curve: str = "ed25519") -> EngineKey:
         ),
         topology="mesh" if mesh else "single",
         device_prep=bool(getattr(config, "device_prep", False)),
+        # Env-derived on purpose (no config attr): the lane is chosen at
+        # trace time by CTPU_MXU_LIMBS, so the key mirrors the env instead
+        # of introducing a knob the traced graphs could contradict.
+        mxu=os.environ.get("CTPU_MXU_LIMBS", "") == "1",
     )
 
 

@@ -176,6 +176,19 @@ def _cost_number(analysis, key: str) -> Optional[float]:
     return float(v) if v is not None else None
 
 
+def kernel_lane_suffix() -> str:
+    """``"_mxu"`` when the process runs the MXU field lane
+    (``CTPU_MXU_LIMBS=1``), else ``""``.
+
+    Engine modules append this to their ``instrumented_jit`` names at
+    import time, so an MXU-lane run's launches/compiles/cost_analysis land
+    under ``ed25519.verify_mxu`` etc. instead of overwriting the headline
+    VPU ledger keys — the device A/B reads both side by side."""
+    import os
+
+    return "_mxu" if os.environ.get("CTPU_MXU_LIMBS", "") == "1" else ""
+
+
 def instrumented_jit(
     fn, name: str, *, registry: Optional[KernelRegistry] = None, **jit_kwargs
 ):
@@ -222,4 +235,5 @@ __all__ = [
     "TENANT_KERNELS",
     "TenantAccounting",
     "instrumented_jit",
+    "kernel_lane_suffix",
 ]

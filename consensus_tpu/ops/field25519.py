@@ -21,14 +21,18 @@ convolution) followed by *parallel* carry-save passes (split with
 borrows propagate like arithmetic shifts).  There are no sequential carry
 chains on the hot path.
 
-Why pure XLA and no hand-written Pallas kernel: the verify graph is a
-``lax.scan`` of elementwise/broadcast limb arithmetic, which XLA already
-fuses into large VPU kernels; a per-field-op ``pallas_call`` only adds
-launch overhead (a round-2 prototype confirmed parity but no win and was
-removed).  The remaining headroom is a kernel holding the whole 64-step
-scan carry + per-batch table in VMEM — that design needs on-device
-iteration to validate Pallas/Mosaic lowering, and is deferred until TPU
-access is available in-round (see COVERAGE.md).
+Why pure XLA and no hand-written Pallas kernel *on this lane*: the verify
+graph is a ``lax.scan`` of elementwise/broadcast limb arithmetic, which
+XLA already fuses into large VPU kernels; a per-field-op ``pallas_call``
+only adds launch overhead (a round-2 prototype confirmed parity but no
+win and was removed).  The two deferred headroom items both landed behind
+``CTPU_MXU_LIMBS=1``: :mod:`consensus_tpu.ops.mxu_limbs` re-expresses the
+schoolbook convolution as integer ``dot_general`` tiles for the MXU
+(``mul``/``square`` below dispatch there at trace time, bit-identical
+output), and :mod:`consensus_tpu.ops.pallas_scan` grew the VMEM-resident
+Straus/MSM kernel that keeps the 64-step doubling chain's table and
+accumulator on-chip.  Measured CPU denominators for the A/B live in
+BASELINE.md ("MXU lane" section).
 
 Normalization contract: public ops take and return *weakly reduced*
 elements — |limb| <= 340 with value within (-2^250, 2^255 + 2^13), exact
@@ -152,6 +156,8 @@ def _weak_reduce(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    if limbs.counting():
+        limbs.note_add(_note_lanes(a, b))
     return _weak_reduce(a + b)
 
 
@@ -184,6 +190,8 @@ _TWO_P = np.array(
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     # a + 2p - b stays positive for any weakly reduced a, b (< 2p each).
+    if limbs.counting():
+        limbs.note_add(_note_lanes(a, b))
     return _weak_reduce(a + _cexpand(_TWO_P, a) - b)
 
 
@@ -204,7 +212,16 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
     Exact while |a_limb| * |b_limb| <= 2^19 (columns sum 32 products under
     the f32 24-bit integer window) — weakly reduced inputs and one raw
-    add/sub level both qualify."""
+    add/sub level both qualify.
+
+    With ``CTPU_MXU_LIMBS=1`` (trace-time) this dispatches to the
+    bit-identical MXU lane, which records its work as ``note_dot`` MACs —
+    the dispatch sits BEFORE the ``note_mul`` so a counted trace reports
+    muls or dots per site, never both."""
+    from consensus_tpu.ops import mxu_limbs
+
+    if mxu_limbs.lane_active():
+        return mxu_limbs.mul25519(a, b)
     if limbs.counting():
         limbs.note_mul(_note_lanes(a, b))
     batch_pad = [(0, 0)] * (a.ndim - 1)
@@ -220,7 +237,15 @@ def square(a: jnp.ndarray) -> jnp.ndarray:
     of :func:`mul`.
 
     Exactness requires |limb| <= 500 (2 * 500^2 * 32 < 2^24); callers with
-    one-raw-level inputs (bound 680) must use ``mul(x, x)`` instead."""
+    one-raw-level inputs (bound 680) must use ``mul(x, x)`` instead.
+
+    The MXU lane squares via ``mul(a, a)`` — the full product columns
+    equal these doubled-triangle columns as integers, so the output stays
+    bit-identical."""
+    from consensus_tpu.ops import mxu_limbs
+
+    if mxu_limbs.lane_active():
+        return mxu_limbs.square25519(a)
     if limbs.counting():
         limbs.note_square(_note_lanes(a))
     batch_pad = [(0, 0)] * (a.ndim - 1)

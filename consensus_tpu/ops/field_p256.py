@@ -21,7 +21,18 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from consensus_tpu.ops import limbs
 from consensus_tpu.ops.limbs import carry_i32
+
+
+def _note_lanes(a, b=None) -> int:
+    """Independent field elements an op touches (see field25519 twin)."""
+    shape = a.shape if b is None else jnp.broadcast_shapes(a.shape, b.shape)
+    lanes = 1
+    for dim in shape[1:]:
+        lanes *= int(dim)
+    return lanes
+
 
 LIMBS = 32
 LIMB_BITS = 8
@@ -126,6 +137,8 @@ def _reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
         jnp.asarray(_SOLINAS_M), x, axes=([1], [0]),
         precision=jax.lax.Precision.HIGHEST,
     )  # |limb| < 2^20
+    if limbs.counting():
+        limbs.note_dot(LIMBS, 1, 2 * LIMBS, _note_lanes(x))
 
     # Two light rounds: carry-save + fold the single overflow limb through
     # the 2^256 pattern.  Lands |limb| <= ~300.
@@ -140,6 +153,8 @@ def _reduce_wide(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    if limbs.counting():
+        limbs.note_add(_note_lanes(a, b))
     return _reduce_wide(a + b)
 
 
@@ -192,12 +207,24 @@ def _get_bias() -> np.ndarray:
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     # Bias with a multiple of p large enough to keep the value positive for
     # any weakly reduced operands.
+    if limbs.counting():
+        limbs.note_add(_note_lanes(a, b))
     return _reduce_wide(a + _cexpand(_get_bias(), a) - b)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook convolution (32 broadcast multiplies + shifted adds) then
-    the Solinas fold.  Weakly reduced inputs keep columns exact in f32."""
+    the Solinas fold.  Weakly reduced inputs keep columns exact in f32.
+
+    ``CTPU_MXU_LIMBS=1`` dispatches to the bit-identical MXU lane (before
+    the note, so counted traces report dots instead of muls — same
+    discipline as field25519.mul)."""
+    from consensus_tpu.ops import mxu_limbs
+
+    if mxu_limbs.lane_active():
+        return mxu_limbs.mul_p256(a, b)
+    if limbs.counting():
+        limbs.note_mul(_note_lanes(a, b))
     batch_pad = [(0, 0)] * (a.ndim - 1)
     terms = [
         jnp.pad(a[i] * b, [(i, LIMBS - 1 - i)] + batch_pad) for i in range(LIMBS)
@@ -206,6 +233,12 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def square(a: jnp.ndarray) -> jnp.ndarray:
+    from consensus_tpu.ops import mxu_limbs
+
+    if mxu_limbs.lane_active():
+        return mxu_limbs.square_p256(a)
+    if limbs.counting():
+        limbs.note_square(_note_lanes(a))
     batch_pad = [(0, 0)] * (a.ndim - 1)
     doubled = a + a
     terms = []

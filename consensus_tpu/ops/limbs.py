@@ -82,21 +82,60 @@ _SCAN_WEIGHTS: list[int] = []
 #: schoolbook limb stack (the symmetric half of the product terms).
 SQUARE_M_RATIO = 0.55
 
+#: One VPU field multiplication is 32x32 = 1024 byte-level MACs; dense
+#: ``dot_general`` MACs convert to mul-equivalents at this rate so the
+#: MXU-vs-VPU denominator compares like with like (note_byte_muls already
+#: uses the same 1024-MAC yardstick).
+DOT_MACS_PER_MUL = 1024
+
 
 class FieldOpCount:
-    """Tally of field multiplications observed during one traced region."""
+    """Tally of field operations observed during one traced region.
+
+    ``muls``/``squares`` count semantic field ops on the VPU lane;
+    ``dots``/``dot_macs`` count ``dot_general`` contractions (the MXU lane
+    dispatches *before* noting, so a trace records muls OR dots per mul
+    site, never both); ``adds`` counts field additions/subtractions —
+    cheap, but the per-kernel breakdown (satellite of ISSUE 18) wants the
+    full shape of the work, not just the expensive tail.
+    """
 
     def __init__(self) -> None:
         self.muls = 0
         self.squares = 0
+        self.adds = 0
+        self.dots = 0
+        self.dot_macs = 0
 
     @property
     def m_equiv(self) -> float:
-        """Generic-multiplication equivalents (1 S ~ 0.55 M)."""
-        return self.muls + SQUARE_M_RATIO * self.squares
+        """Generic-multiplication equivalents (1 S ~ 0.55 M; 1024 dense
+        dot MACs ~ 1 M — adds are deliberately excluded, matching the
+        pinned round-7 baseline semantics)."""
+        return (
+            self.muls
+            + SQUARE_M_RATIO * self.squares
+            + self.dot_macs / DOT_MACS_PER_MUL
+        )
+
+    def as_dict(self) -> dict:
+        """Per-kernel breakdown for bench JSON (muls vs dot-equivalents
+        vs adds), so engine PRs inherit the richer denominator for free."""
+        return {
+            "muls": self.muls,
+            "squares": self.squares,
+            "adds": self.adds,
+            "dots": self.dots,
+            "dot_macs": self.dot_macs,
+            "dot_m_equiv": round(self.dot_macs / DOT_MACS_PER_MUL, 3),
+            "m_equiv": round(self.m_equiv, 3),
+        }
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
-        return f"FieldOpCount(muls={self.muls}, squares={self.squares})"
+        return (
+            f"FieldOpCount(muls={self.muls}, squares={self.squares}, "
+            f"adds={self.adds}, dots={self.dots}, dot_macs={self.dot_macs})"
+        )
 
 
 def counting() -> bool:
@@ -122,6 +161,22 @@ def note_square(lanes: int = 1) -> None:
     """Record a field squaring over ``lanes`` independent elements."""
     if _COUNTERS:
         _note("squares", lanes)
+
+
+def note_add(lanes: int = 1) -> None:
+    """Record a field addition/subtraction over ``lanes`` elements."""
+    if _COUNTERS:
+        _note("adds", lanes)
+
+
+def note_dot(m: int, n: int, k: int, lanes: int = 1) -> None:
+    """Record a ``dot_general`` contraction of an (m, k) by (k, n) tile
+    per lane.  Counted as dense MACs — the MXU does not skip structural
+    zeros in a constant operand, so m*n*k is the honest per-lane cost the
+    device A/B has to amortize, not the nonzero count."""
+    if _COUNTERS:
+        _note("dots", lanes)
+        _note("dot_macs", m * n * k * lanes)
 
 
 def note_byte_muls(byte_muls: int, lanes: int = 1) -> None:
@@ -178,15 +233,19 @@ def measure_field_ops(fn, *args, **kwargs) -> FieldOpCount:
     Uses ``jax.eval_shape`` — no compilation, no execution, no device — so
     counting a batch-512 verify kernel takes seconds on any host.  ``fn``
     must be the *unjitted* implementation (a cached jit would skip tracing
-    and report zero).
+    and report zero).  A fresh wrapper busts eval_shape's own trace cache
+    each call — without it, measuring the same fn + shapes twice (the
+    MXU-vs-VPU A/B does exactly that) silently reports zeros the second
+    time.
     """
     with count_field_ops() as counter:
-        jax.eval_shape(fn, *args, **kwargs)
+        jax.eval_shape(lambda *a, **k: fn(*a, **k), *args, **kwargs)
     return counter
 
 
 __all__ = [
     "carry_i32",
+    "DOT_MACS_PER_MUL",
     "FieldOpCount",
     "SQUARE_M_RATIO",
     "count_field_ops",
@@ -194,7 +253,9 @@ __all__ = [
     "counting",
     "lt_bytes",
     "measure_field_ops",
+    "note_add",
     "note_byte_muls",
+    "note_dot",
     "note_mul",
     "note_square",
 ]

@@ -23,6 +23,17 @@ records ``env CTPU_PALLAS_SCAN=1 python bench.py`` next to the XLA
 number (benchmarks/run_device_suite.sh, priority 5).  The scan stays
 opt-in (``CTPU_PALLAS_SCAN=1``) until that A/B proves a win.
 
+This module also hosts the **MXU-lane Straus/MSM kernel**
+(:func:`straus_msm`, gated on ``CTPU_MXU_LIMBS=1``): the randomized batch
+verifier's shared-doubling multi-scalar multiplication with the TWO
+9-entry window tables (A and R) and the running-sum accumulator resident
+in VMEM across the whole 64-window chain.  It reuses this file's
+constant-injection machinery; kernel bodies trace under
+``mxu_limbs.suppress_mxu_limbs()`` so no ``dot_general`` reaches Mosaic —
+inside a kernel the VPU schoolbook is the validated shape, and the MXU
+lane's field contractions apply to the XLA-scheduled remainder of the
+graph instead.
+
 Reference context: this accelerates the commit-signature sweep the
 reference runs as a sequential per-goroutine CPU loop
 (reference internal/bft/view.go:537-541).
@@ -44,6 +55,7 @@ from jax.experimental import pallas as pl
 from consensus_tpu.ops import ed25519 as ed
 from consensus_tpu.ops import field25519 as fe
 from consensus_tpu.ops import field_p256 as fp
+from consensus_tpu.ops import mxu_limbs
 from consensus_tpu.ops import p256
 
 #: Lane tile: the TPU vector lane width is 128; larger tiles amortize the
@@ -145,7 +157,12 @@ def _inject_consts(bank: jnp.ndarray):
     fe.constant_like = traced_constant_like
     fe._TWO_P = bank[2]
     try:
-        yield
+        # Kernel bodies must trace the VPU schoolbook even when the process
+        # runs the MXU lane: a dot_general inside a Mosaic kernel is
+        # unvalidated lowering, and the injection window IS the kernel
+        # trace (serialized by _INJECT_LOCK, so the global flip is safe).
+        with mxu_limbs.suppress_mxu_limbs():
+            yield
     finally:
         fe.constant_like = orig_constant_like
         fe._TWO_P = orig_two_p
@@ -282,7 +299,8 @@ def _inject_consts_p256(bank: jnp.ndarray, solinas: jnp.ndarray,
     fp._SOLINAS_M = solinas
     fp._BIAS = bias
     try:
-        yield
+        with mxu_limbs.suppress_mxu_limbs():  # see _inject_consts
+            yield
     finally:
         fp.constant_like = orig_constant_like
         fp._SOLINAS_M = orig_solinas
@@ -375,10 +393,175 @@ def horner_scan_p256(
     return p256.Point(x=x, y=y, z=z)
 
 
+# --- MXU-lane Straus/MSM kernel (CTPU_MXU_LIMBS=1) --------------------------
+
+
+def msm_config(batch: int):
+    """(tile, interpret) when the VMEM-resident Straus/MSM kernel should
+    replace the XLA scan inside :func:`ed.straus_shared_msm`, else None.
+
+    Rides the ``CTPU_MXU_LIMBS=1`` lane (ISSUE 18 tentpole b) — the MSM
+    kernel is the VMEM half of the MXU bet, so one flag A/Bs both; opt
+    back out of just the kernel with ``CTPU_MXU_MSM=0`` (e.g. to isolate
+    the field-contraction win, or after a Mosaic lowering failure —
+    record the failure in BASELINE.md, don't let it read as "no
+    difference").  Suppression (:func:`suppress_pallas_scan`) wins: the
+    sharded verifiers trace under it, so the mesh lanes keep the plain
+    XLA MSM while the MXU *field* lane stays active under shard_map.
+
+    Same no-silent-fallback contract as :func:`scan_config`: a batch that
+    cannot tile under the explicit opt-in raises."""
+    if not mxu_limbs.lane_active() or _SUPPRESSED:
+        return None
+    if os.environ.get("CTPU_MXU_MSM", "") == "0":
+        return None
+    tile = int(os.environ.get("CTPU_MXU_MSM_TILE", "0")) or None
+    if tile is None:
+        tile = DEFAULT_TILE if batch >= DEFAULT_TILE else batch
+    if batch % tile != 0:
+        raise ValueError(
+            f"CTPU_MXU_LIMBS=1 selects the VMEM MSM kernel but batch "
+            f"{batch} does not tile by {tile}; fix CTPU_MXU_MSM_TILE or "
+            "pad the batch — refusing a silent XLA fallback that would "
+            "invalidate the A/B (CTPU_MXU_MSM=0 opts out explicitly)"
+        )
+    return tile, jax.default_backend() == "cpu"
+
+
+def _msm_kernel(n_low, consts_ref, zk_ref, z_ref,
+                ax_ref, ay_ref, az_ref, at_ref,
+                rx_ref, ry_ref, rz_ref, rt_ref,
+                ox_ref, oy_ref, oz_ref, ot_ref):
+    """One batch tile's full shared-doubling MSM: rebuild both 9-entry
+    window tables in VMEM, run all 64 windows (``64 - n_low`` A-only, then
+    ``n_low`` combined), reduce the tile to ONE partial-sum point.
+
+    The in-kernel tables come from 7 sequential adds off the base points
+    (table entry 1), not :func:`ed.multiples_table9`'s doubling-optimized
+    build — different *projective representatives* of the same group
+    elements, which is fine: per-tile partials add by linearity and the
+    engines' verdict checks (``is_identity``, ``equal``) are invariant
+    under projective scaling, so verdicts stay byte-identical to the XLA
+    lane (the parity gate tests/test_mxu_limbs.py pins exactly that)."""
+    zk = zk_ref[...]  # (64, tile) int32, digit + 8, MSB window first
+    zz = z_ref[...]   # (n_low, tile)
+    n_high = _WINDOWS - n_low
+    with _inject_consts(consts_ref[...]):
+        a1 = ed.Point(ax_ref[...], ay_ref[...], az_ref[...], at_ref[...])
+        r1 = ed.Point(rx_ref[...], ry_ref[...], rz_ref[...], rt_ref[...])
+
+        def build_table(p):
+            tab = [ed.identity_like(p.x), p]
+            for _ in range(_TABLE - 2):
+                tab.append(ed.add(tab[-1], p))
+            return tab
+
+        a_tab = build_table(a1)
+        r_tab = build_table(r1)
+
+        def lookup(table, d):  # d: (1, tile) signed digit
+            # Rank-2-only one-hot contraction (see _scan_kernel's note on
+            # Mosaic lowering risk).
+            coords = []
+            for sel in ("x", "y", "z", "t"):
+                acc = None
+                for j, entry in enumerate(table):
+                    mask = (jnp.abs(d) == j).astype(jnp.float32)
+                    term = getattr(entry, sel) * mask
+                    acc = term if acc is None else acc + term
+                coords.append(acc)
+            q = ed.Point(*coords)
+            return ed.select(d[0] < 0, ed.negate(q), q)
+
+        def fold(acc, contrib):
+            for _ in range(3):
+                acc = ed.double(acc, need_t=False)
+            acc = ed.double(acc)  # materialize T for the add
+            return ed.add(acc, ed.batch_sum(contrib))
+
+        def step_high(i, carry):
+            acc = ed.Point(*carry)
+            d = jax.lax.dynamic_slice_in_dim(zk, i, 1, axis=0) - 8
+            acc = fold(acc, lookup(a_tab, d))
+            return (acc.x, acc.y, acc.z, acc.t)
+
+        def step_low(w, carry):
+            acc = ed.Point(*carry)
+            dzk = jax.lax.dynamic_slice_in_dim(zk, n_high + w, 1, axis=0) - 8
+            dz = jax.lax.dynamic_slice_in_dim(zz, w, 1, axis=0) - 8
+            contrib = ed.add(lookup(a_tab, dzk), lookup(r_tab, dz))
+            acc = fold(acc, contrib)
+            return (acc.x, acc.y, acc.z, acc.t)
+
+        ident = ed.identity_like(a1.x[..., :1])  # (32, 1) accumulator
+        carry = (ident.x, ident.y, ident.z, ident.t)
+        carry = jax.lax.fori_loop(0, n_high, step_high, carry)
+        x, y, z, t = jax.lax.fori_loop(0, n_low, step_low, carry)
+    ox_ref[...] = x
+    oy_ref[...] = y
+    oz_ref[...] = z
+    ot_ref[...] = t
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def straus_msm(
+    ax: jnp.ndarray,        # (32, batch) f32 — the negated A base points
+    ay: jnp.ndarray,
+    az: jnp.ndarray,
+    at: jnp.ndarray,
+    rx: jnp.ndarray,        # (32, batch) f32 — the R base points
+    ry: jnp.ndarray,
+    rz: jnp.ndarray,
+    rt: jnp.ndarray,
+    zk_digits: jnp.ndarray,  # (64, batch), digit + 8, MSB window first
+    z_digits: jnp.ndarray,   # (Wz, batch), digit + 8, MSB window first
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: bool = False,
+) -> ed.Point:
+    """Σᵢ [zkᵢ]Aᵢ' + Σᵢ [zᵢ]Rᵢ' with the doubling chain, both window
+    tables, and the accumulator VMEM-resident per batch tile.
+
+    Each grid program pays its own 64-window doubling chain on a (32, 1)
+    accumulator — ``batch/tile`` chains total vs the XLA lane's single
+    chain.  The chain was already amortized noise at batch 512 (~256
+    doubles against ~34k lookup/add muls); what the kernel buys is the
+    scan carry, tables, and per-window intermediates never touching HBM.
+    Per-tile partial sums come back and one log-depth :func:`ed.batch_sum`
+    joins them."""
+    batch = ax.shape[-1]
+    if batch % tile != 0:
+        raise ValueError(f"batch {batch} not divisible by tile {tile}")
+    n_low = z_digits.shape[0]
+    grid = (batch // tile,)
+    consts_spec = pl.BlockSpec((3, fe.LIMBS), lambda i: (0, 0))
+    coord_spec = pl.BlockSpec((fe.LIMBS, tile), lambda i: (0, i))
+    zk_spec = pl.BlockSpec((_WINDOWS, tile), lambda i: (0, i))
+    z_spec = pl.BlockSpec((n_low, tile), lambda i: (0, i))
+    part_spec = pl.BlockSpec((fe.LIMBS, 1), lambda i: (0, i))
+    part_shape = jax.ShapeDtypeStruct((fe.LIMBS, batch // tile), jnp.float32)
+    x, y, z, t = pl.pallas_call(
+        functools.partial(_msm_kernel, n_low),
+        grid=grid,
+        in_specs=[consts_spec, zk_spec, z_spec] + [coord_spec] * 8,
+        out_specs=[part_spec] * 4,
+        out_shape=[part_shape] * 4,
+        interpret=interpret,
+    )(
+        jnp.asarray(_const_bank_np()),
+        zk_digits.astype(jnp.int32),
+        z_digits.astype(jnp.int32),
+        ax, ay, az, at, rx, ry, rz, rt,
+    )
+    return ed.batch_sum(ed.Point(x=x, y=y, z=z, t=t))
+
+
 __all__ = [
     "horner_scan",
     "horner_scan_p256",
+    "msm_config",
     "scan_config",
+    "straus_msm",
     "suppress_pallas_scan",
     "DEFAULT_TILE",
 ]

@@ -52,7 +52,11 @@ from consensus_tpu.models.fused import (
     FusedEd25519BatchVerifier,
     FusedEd25519RandomizedBatchVerifier,
 )
-from consensus_tpu.obs.kernels import COMPILE_CACHE, instrumented_jit
+from consensus_tpu.obs.kernels import (
+    COMPILE_CACHE,
+    instrumented_jit,
+    kernel_lane_suffix,
+)
 from consensus_tpu.parallel.topology import (
     BATCH_AXIS,
     MeshTopology,
@@ -267,7 +271,7 @@ def sharded_verify_fn(mesh: Mesh):
         total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axes)
         return ok, total
 
-    return instrumented_jit(_shard, "ed25519.sharded_verify")
+    return instrumented_jit(_shard, "ed25519.sharded_verify" + kernel_lane_suffix())
 
 
 class ShardedEd25519Verifier(_MeshEngine, Ed25519BatchVerifier):
@@ -354,7 +358,7 @@ def sharded_p256_verify_fn(mesh: Mesh):
         total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axes)
         return ok, total
 
-    return instrumented_jit(_shard, "ecdsa_p256.sharded_verify")
+    return instrumented_jit(_shard, "ecdsa_p256.sharded_verify" + kernel_lane_suffix())
 
 
 class ShardedEcdsaP256Verifier(_MeshEngine, EcdsaP256BatchVerifier):
@@ -444,7 +448,9 @@ def sharded_batch_verify_fn(mesh: Mesh):
         bad = jax.lax.psum(1 - eq_ok.astype(jnp.int32), axes)
         return bad == 0, valid
 
-    return instrumented_jit(_shard, "ed25519.sharded_batch_verify")
+    return instrumented_jit(
+        _shard, "ed25519.sharded_batch_verify" + kernel_lane_suffix()
+    )
 
 
 class ShardedEd25519RandomizedVerifier(_MeshEngine, Ed25519RandomizedBatchVerifier):
@@ -575,7 +581,9 @@ def sharded_fused_verify_fn(mesh: Mesh):
         total = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), axes)
         return ok, total
 
-    return instrumented_jit(_shard, "ed25519.sharded_fused_verify")
+    return instrumented_jit(
+        _shard, "ed25519.sharded_fused_verify" + kernel_lane_suffix()
+    )
 
 
 class ShardedFusedEd25519Verifier(_MeshEngine, FusedEd25519BatchVerifier):
@@ -754,7 +762,9 @@ def sharded_fused_aggregate_fn(mesh: Mesh, tag: bytes, n: int, padded: int):
         bad = jax.lax.psum(1 - eq_ok.astype(jnp.int32), axes)
         return bad == 0, valid
 
-    return instrumented_jit(_shard, "ed25519.sharded_fused_batch_verify")
+    return instrumented_jit(
+        _shard, "ed25519.sharded_fused_batch_verify" + kernel_lane_suffix()
+    )
 
 
 class ShardedFusedEd25519RandomizedVerifier(

@@ -16,6 +16,22 @@ asserting exactly which subsystem receives the message:
   3-phase and view-change alike — but still credits leader traffic as
   heartbeats, and a stopped controller routes nothing at all.
 
+Grown (per the COVERAGE.md stub) with three further reference families,
+each table-driven the same way:
+
+* leader-rotation boundaries: with ``leader_rotation`` on, ``decide``
+  rotates to the next leader exactly every ``decisions_per_leader``
+  decisions (reference controller.go:560-574 via TestLeaderRotation);
+* decide interleaved with sync: a commit for a sequence the replica
+  already obtained via sync consults the synchronizer instead of
+  double-delivering (the MutuallyExclusiveDeliver guard,
+  controller.go:928-965);
+* the request timeout cascade: pool stage 1 forwards to the leader,
+  stage 2 complains to the view changer, stage 3 drops — with the
+  leader skipping self-forwarding and voting-suspended replicas
+  forwarding but never complaining (requestpool.go:493-567 +
+  controller.go:233-246).
+
 The harness reuses the scripted-collaborator shape of
 test_controller_sync.py with recorder stubs on every sink.
 """
@@ -29,11 +45,19 @@ from consensus_tpu.core.batcher import Batcher
 from consensus_tpu.core.controller import Controller
 from consensus_tpu.core.pool import PoolOptions, RequestPool
 from consensus_tpu.core.state import InFlightData, PersistedState
+from consensus_tpu.core.view import Phase
 from consensus_tpu.runtime import SimScheduler
 from consensus_tpu.testing import MemWAL
 from consensus_tpu.testing.app import ByteInspector
 from consensus_tpu.testing.app import TestApp as PortsApp
-from consensus_tpu.types import Checkpoint, Proposal, Signature
+from consensus_tpu.types import (
+    Checkpoint,
+    Decision,
+    Proposal,
+    Reconfig,
+    Signature,
+    SyncResponse,
+)
 from consensus_tpu.wire import (
     Commit,
     HeartBeat,
@@ -45,6 +69,8 @@ from consensus_tpu.wire import (
     StateTransferRequest,
     StateTransferResponse,
     ViewChange,
+    ViewMetadata,
+    encode_view_metadata,
 )
 
 NODES = (1, 2, 3, 4)
@@ -62,14 +88,44 @@ class _RecordingView:
     def handle_message(self, sender, msg):
         self.messages.append((sender, msg))
 
+    def start(self):
+        pass
+
     def abort(self):
         self.stopped = True
+
+
+class _ViewFactory:
+    """ProposalMaker stub: records every ``new_proposer`` call (the rotation
+    tests assert on exactly when a fresh view is started, and under which
+    leader) and hands back a fresh recorder view."""
+
+    def __init__(self):
+        self.calls = []  # (leader, proposal_sequence)
+
+    def new_proposer(self, leader, proposal_sequence, view_num, decisions):
+        self.calls.append((leader, proposal_sequence))
+        view = _RecordingView()
+        view.leader_id = leader
+        view.proposal_sequence = proposal_sequence
+        return view, Phase.COMMITTED
+
+
+class _RecordingSynchronizer:
+    def __init__(self):
+        self.calls = 0
+        self.response = SyncResponse()
+
+    def sync(self):
+        self.calls += 1
+        return self.response
 
 
 class _RecordingVC:
     def __init__(self):
         self.messages = []
         self.view_messages = []
+        self.complaints = []  # (view, stop_view) from start_view_change
 
     def handle_message(self, sender, msg):
         self.messages.append((sender, msg))
@@ -78,7 +134,7 @@ class _RecordingVC:
         self.view_messages.append((sender, msg))
 
     def start_view_change(self, view, stop_view):
-        pass
+        self.complaints.append((view, stop_view))
 
     def inform_new_view(self, view):
         pass
@@ -114,14 +170,24 @@ class _RecordingCollector:
 
 
 class _Harness:
-    def __init__(self):
+    def __init__(
+        self,
+        *,
+        leader_rotation=False,
+        decisions_per_leader=0,
+        pool_options=None,
+        wire_pool_cascade=False,
+    ):
         self.sched = SimScheduler()
         self.app = PortsApp(SELF, self)
         self.sent = []
+        self.sent_tx = []  # forwarded raw requests: (target, raw)
         self.view = _RecordingView()
         self.vc = _RecordingVC()
         self.monitor = _RecordingMonitor()
         self.collector = _RecordingCollector()
+        self.proposer = _ViewFactory()
+        self.synchronizer = _RecordingSynchronizer()
         outer = self
 
         class CommStub:
@@ -129,18 +195,22 @@ class _Harness:
                 outer.sent.append((target, msg))
 
             def send_transaction(self, target, raw):
-                pass
+                outer.sent_tx.append((target, raw))
 
             def nodes(self):
                 return NODES
 
         in_flight = InFlightData()
         state = PersistedState(MemWAL([]), in_flight, entries=[])
-        pool = RequestPool(self.sched, ByteInspector(), PoolOptions())
+        self.pool = pool = RequestPool(
+            self.sched, ByteInspector(), pool_options or PoolOptions()
+        )
         self.controller = Controller(
             scheduler=self.sched,
             config=Configuration(
-                self_id=SELF, leader_rotation=False, decisions_per_leader=0
+                self_id=SELF,
+                leader_rotation=leader_rotation,
+                decisions_per_leader=decisions_per_leader,
             ),
             nodes=NODES,
             comm=CommStub(),
@@ -148,7 +218,7 @@ class _Harness:
             assembler=self.app,
             verifier=self.app,
             signer=self.app,
-            synchronizer=None,
+            synchronizer=self.synchronizer,
             pool=pool,
             batcher=Batcher(self.sched, pool, batch_max_count=10,
                             batch_max_bytes=10**6, batch_max_interval=0.05),
@@ -157,9 +227,14 @@ class _Harness:
             state=state,
             in_flight=in_flight,
             checkpoint=Checkpoint(),
-            proposer_builder=None,
+            proposer_builder=self.proposer,
             view_changer=self.vc,
         )
+        if wire_pool_cascade:
+            # The facade wires the pool's timeout handler to the controller
+            # after construction (same ChangeOptions seam as the reference's
+            # pkg/consensus/consensus.go:231); the cascade tests need it.
+            pool.change_options(timeout_handler=self.controller)
         # Route straight into recorders: no real view machinery, and no
         # Controller.start() (which would build one).  The controller boots
         # stopped; flip the flag the way start() does.
@@ -170,6 +245,9 @@ class _Harness:
     # cluster duck-typing for TestApp
     def longest_ledger(self, *, exclude):
         return []
+
+    def reconfig_of(self, proposal):
+        return Reconfig()
 
     def sinks(self):
         """Which recorders saw anything, as a sorted tuple of names."""
@@ -301,3 +379,181 @@ def test_state_request_reply_carries_current_view_and_sequence():
     assert target == 3
     assert isinstance(reply, StateTransferResponse)
     assert reply.view_num == h.controller.curr_view_number
+
+
+# ---------------------------------------------------------------------------
+# Leader rotation at decisionsPerLeader boundaries
+# ---------------------------------------------------------------------------
+
+
+def _decided(seq, decisions=0, view=0):
+    """A committed proposal as ``decide`` receives it, metadata included."""
+    return Proposal(
+        payload=b"p",
+        metadata=encode_view_metadata(ViewMetadata(
+            view_id=view, latest_sequence=seq, decisions_in_view=decisions,
+        )),
+    )
+
+
+#: Each row: name, decisions_per_leader, number of decisions fed through
+#: ``decide``, and the exact (new_leader, new_proposal_seq) sequence of view
+#: restarts the rotation boundary must produce (view 0 starts at leader 1;
+#: rotation walks the ring 1 -> 2 -> 3 -> 4).
+ROTATION_TABLE = [
+    ("no_rotation_below_boundary", 2, 1, []),
+    ("rotates_exactly_at_boundary", 2, 2, [(2, 3)]),
+    ("holds_between_boundaries", 2, 3, [(2, 3)]),
+    ("second_boundary_rotates_again", 2, 4, [(2, 3), (3, 5)]),
+    ("rotates_every_decision_at_one", 1, 3, [(2, 2), (3, 3), (4, 4)]),
+]
+
+
+@pytest.mark.parametrize(
+    "name,per_leader,n_decides,expected_views",
+    ROTATION_TABLE,
+    ids=[row[0] for row in ROTATION_TABLE],
+)
+def test_rotation_boundaries(name, per_leader, n_decides, expected_views):
+    h = _Harness(leader_rotation=True, decisions_per_leader=per_leader)
+    for i in range(1, n_decides + 1):
+        h.controller.decide(_decided(seq=i, decisions=i - 1), [], [])
+    assert h.proposer.calls == expected_views
+    assert h.controller.curr_decisions_in_view == n_decides
+    # The checkpoint advanced through every decision regardless of rotation.
+    assert h.controller.latest_seq() == n_decides
+    assert len(h.app.ledger) == n_decides
+
+
+def test_rotation_restarts_pool_timers():
+    h = _Harness(leader_rotation=True, decisions_per_leader=1)
+    restarted = []
+    orig = h.pool.restart_timers
+    h.pool.restart_timers = lambda: (restarted.append(True), orig())
+    h.controller.decide(_decided(seq=1), [], [])
+    assert restarted, "crossing the rotation boundary must restart the cascade"
+
+
+def test_no_rotation_without_the_config_flag():
+    h = _Harness(leader_rotation=False, decisions_per_leader=1)
+    for i in range(1, 4):
+        h.controller.decide(_decided(seq=i, decisions=i - 1), [], [])
+    assert h.proposer.calls == []
+
+
+# ---------------------------------------------------------------------------
+# Decide interleaved with sync (the already-synced delivery guard)
+# ---------------------------------------------------------------------------
+
+#: Each row: name, sequence the replica already synced to, sequence of the
+#: arriving commit decision, and who must handle it: the application
+#: (fresh decision -> deliver) or the synchronizer (already obtained via
+#: sync -> consult it, never double-deliver).
+SYNC_DECIDE_TABLE = [
+    ("fresh_seq_delivers_to_app", 5, 6, "app"),
+    ("same_seq_consults_synchronizer", 5, 5, "sync"),
+    ("stale_seq_consults_synchronizer", 5, 3, "sync"),
+]
+
+
+@pytest.mark.parametrize(
+    "name,synced_seq,decide_seq,expected",
+    SYNC_DECIDE_TABLE,
+    ids=[row[0] for row in SYNC_DECIDE_TABLE],
+)
+def test_decide_interleaved_with_sync(name, synced_seq, decide_seq, expected):
+    h = _Harness()
+    h.controller.checkpoint.set(_decided(seq=synced_seq), [])
+    h.synchronizer.response = SyncResponse(
+        latest=Decision(proposal=_decided(seq=synced_seq), signatures=()),
+    )
+    h.controller.decide(_decided(seq=decide_seq), [], [])
+    if expected == "app":
+        assert len(h.app.ledger) == 1
+        assert h.synchronizer.calls == 0
+        assert h.controller.latest_seq() == decide_seq
+    else:
+        assert h.app.ledger == []  # never double-delivered
+        assert h.synchronizer.calls == 1
+        assert h.controller.latest_seq() == synced_seq
+    # Either way the decision advanced the in-view counter (parity with the
+    # reference: the slot is decided even when delivery was via sync).
+    assert h.controller.curr_decisions_in_view == 1
+
+
+def test_synced_decide_releases_pool_reservations():
+    """A slot decided-via-sync never hits per-delivery request removal, so
+    its pipelined reservations must be released or they pin pooled requests
+    forever (the guard's release_reservations call)."""
+    h = _Harness()
+    h.controller.submit_request(b"c1:ra|req-a")
+    h.pool.reserve_raws([b"c1:ra|req-a"])
+    assert h.pool.available_count == 0
+    h.controller.checkpoint.set(_decided(seq=5), [])
+    h.controller.decide(_decided(seq=5), [], [])
+    assert h.pool.available_count == 1
+
+
+# ---------------------------------------------------------------------------
+# Request timeout cascade: forward -> complain -> auto-remove
+# ---------------------------------------------------------------------------
+
+_CASCADE_OPTS = PoolOptions(
+    forward_timeout=0.5, complain_timeout=1.0, auto_remove_timeout=2.0
+)
+
+#: Each row: name, view number (picks the leader: view 0 -> node 1, a
+#: follower's view; view 1 -> node 2 == SELF, the leader's own view),
+#: replica state, whether stage 1 must forward the raw request to the
+#: leader, and whether stage 2 must cast a complaint.
+CASCADE_TABLE = [
+    ("follower_forwards_then_complains", 0, "normal", True, True),
+    ("leader_skips_self_forward_still_complains", 1, "normal", False, True),
+    ("degraded_wal_forwards_but_never_complains", 0, "degraded_wal",
+     True, False),
+]
+
+
+@pytest.mark.parametrize(
+    "name,view_number,state,expect_forward,expect_complaint",
+    CASCADE_TABLE,
+    ids=[row[0] for row in CASCADE_TABLE],
+)
+def test_request_timeout_cascade(
+    name, view_number, state, expect_forward, expect_complaint
+):
+    h = _Harness(pool_options=_CASCADE_OPTS, wire_pool_cascade=True)
+    h.controller.curr_view_number = view_number
+    if state == "degraded_wal":
+        h.controller.set_wal_degraded(True)
+    h.controller.submit_request(b"c1:r1|slow-request")
+
+    h.sched.advance(0.6)  # past stage 1 (forward)
+    if expect_forward:
+        assert h.sent_tx == [(h.controller.leader_id(), b"c1:r1|slow-request")]
+    else:
+        assert h.sent_tx == []
+    assert h.vc.complaints == []  # stage 2 has not fired yet
+
+    h.sched.advance(1.1)  # past stage 2 (complain)
+    if expect_complaint:
+        assert h.vc.complaints == [(view_number, False)]
+    else:
+        assert h.vc.complaints == []
+
+    h.sched.advance(2.1)  # past stage 3 (auto-remove)
+    assert h.pool.count == 0, "stage 3 must drop the request"
+
+
+def test_forwarded_request_lands_in_leader_pool():
+    """The receiving side of stage 1: a forwarded request reaching the
+    (actual) leader is verified and pooled; reaching a non-leader it is
+    dropped with a warning."""
+    h = _Harness()
+    h.controller.curr_view_number = 1  # leader = node 2 == SELF
+    h.controller.handle_request(3, b"c3:rf|forwarded")
+    assert h.pool.count == 1
+
+    h2 = _Harness()  # view 0: leader is node 1, SELF is a follower
+    h2.controller.handle_request(3, b"c3:rf|forwarded")
+    assert h2.pool.count == 0

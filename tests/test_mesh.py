@@ -274,24 +274,62 @@ def test_engine_registry_completeness_and_loud_failures():
     for key in ENGINE_REGISTRY.keys():
         assert key in ENGINE_REGISTRY
         assert callable(ENGINE_REGISTRY.builder(key))
-    # Every cell of the advertised matrix is either registered or refuses
-    # with the curve-specific reason (the Ed25519-only lanes).
+    # Every cell of the advertised matrix — the mxu axis included — is
+    # either registered or refuses with the curve-specific reason (the
+    # Ed25519-only lanes; P-256 × mxu has no MXU Straus/MSM kernel).
     for curve in ENGINE_REGISTRY.curves():
         for mode in MODES:
             for topo in TOPOLOGIES:
                 for prep in (False, True):
-                    key = EngineKey(curve, mode, topo, prep)
-                    if key in ENGINE_REGISTRY:
-                        continue
-                    with pytest.raises(UnknownEngineError) as exc:
-                        ENGINE_REGISTRY.builder(key)
-                    assert "Ed25519-only" in str(exc.value)
+                    for mxu in (False, True):
+                        key = EngineKey(curve, mode, topo, prep, mxu)
+                        if key in ENGINE_REGISTRY:
+                            continue
+                        with pytest.raises(UnknownEngineError) as exc:
+                            ENGINE_REGISTRY.builder(key)
+                        assert "Ed25519-only" in str(exc.value)
     with pytest.raises(UnknownEngineError, match="unknown curve"):
         ENGINE_REGISTRY.builder(EngineKey(curve="ed448"))
     with pytest.raises(ValueError, match="already registered"):
         ENGINE_REGISTRY.register(
             EngineKey(), lambda topology, compile_cache, **kw: None
         )
+
+
+def test_engine_registry_mxu_axis(monkeypatch):
+    """The mxu key axis mirrors the CTPU_MXU_LIMBS environment: every
+    ed25519 cell exists under mxu=True but refuses to BUILD unless the
+    env var actually selects the lane (the traced graph would otherwise be
+    VPU under an MXU label), `engine_key_for` derives the axis from the
+    env, and the degrade ladder preserves it."""
+    import dataclasses as _dc
+
+    from consensus_tpu.models.registry import (
+        ENGINE_REGISTRY,
+        EngineKey,
+        engine_key_for,
+    )
+
+    mxu_key = EngineKey("ed25519", "strict", "single", False, True)
+    assert mxu_key in ENGINE_REGISTRY
+
+    monkeypatch.delenv("CTPU_MXU_LIMBS", raising=False)
+    with pytest.raises(RuntimeError, match="CTPU_MXU_LIMBS"):
+        ENGINE_REGISTRY.build(mxu_key)
+    assert engine_key_for(Configuration(self_id=1)).mxu is False
+
+    monkeypatch.setenv("CTPU_MXU_LIMBS", "1")
+    assert engine_key_for(Configuration(self_id=1)).mxu is True
+    engine = ENGINE_REGISTRY.build(mxu_key)
+    assert engine is not None
+
+    # The degrade ladder never silently switches lanes: every rung of an
+    # mxu key's ladder keeps mxu=True (and stays registered).
+    fused_mesh = EngineKey("ed25519", "randomized", "mesh", True, True)
+    ladder = ENGINE_REGISTRY.degrade_keys(fused_mesh)
+    assert len(ladder) == 3  # mesh -> single, fused -> host prep
+    assert all(k.mxu for k in ladder)
+    assert all(k in ENGINE_REGISTRY for k in ladder)
 
 
 # --- compile cache: rebuilds book zero new compiles --------------------------
