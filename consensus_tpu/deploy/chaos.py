@@ -41,6 +41,12 @@ DEFAULT_ACTION_WEIGHTS = {
     "freeze": 2,
     "listener_drop": 2,
     "storage_fault": 2,
+    # Adversarial wire battery (testing/adversary.py) against one
+    # follower's comm listener.  Weight 0 by default: step() filters
+    # zero-weight actions, so existing seeded soak schedules replay
+    # byte-identically; chaos_sweep --adversarial-net (and soaks that
+    # opt in) raise it.
+    "net_abuse": 0,
 }
 
 #: PR-14 storage fault classes safe to arm while a replica keeps running
@@ -145,6 +151,27 @@ class ProcessChaosSchedule:
             self.launcher.arm_storage_fault(victim, kind, count=1)
             record["target"] = victim
             record["kind"] = kind
+        elif action == "net_abuse":
+            # Real-socket byzantine battery against one follower's comm
+            # listener: the hardened guard must shed it (strikes, quota
+            # rejections, at most a temporary ban of this host's address)
+            # while the soak's liveness probes keep passing.  Nothing to
+            # heal — batteries self-terminate and bans expire.
+            from consensus_tpu.testing.adversary import AdversarialPeer
+
+            victim = self._pick_follower()
+            if victim is not None:
+                addr = self.launcher.spec.comm_addresses()[victim]
+                peer = AdversarialPeer(addr, "comm")
+                provoked = {}
+                for name in ("oversized_length", "wrong_hmac_flood"):
+                    try:
+                        for k, v in getattr(peer, name)(1).items():
+                            provoked[k] = provoked.get(k, 0) + v
+                    except OSError:
+                        pass  # victim mid-restart: the battery found no ear
+                record["target"] = victim
+                record["provoked"] = provoked
 
         self.history.append(record)
         logger.info("chaos: %s -> %s", action, record.get("target"))

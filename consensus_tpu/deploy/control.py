@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Callable, Mapping, Optional, Tuple
 
+from consensus_tpu.net.framing import ListenerGuard
+
 logger = logging.getLogger("consensus_tpu.deploy")
 
 _MAX_LINE = 16 * 1024 * 1024
@@ -43,7 +45,16 @@ class ControlServer:
     handler exception answers ``{"error": ...}`` and keeps serving; an
     unknown op answers ``{"error": "unknown op ..."}`` — the control plane
     must never die under a confused or version-skewed prober.
-    """
+
+    Hardened DEFAULT-ON via a :class:`~consensus_tpu.net.framing
+    .ListenerGuard`: connections are admitted against quotas before a byte
+    is read and served on their own daemon threads (one stalled prober no
+    longer blocks the supervisor's health probe behind it); a request that
+    never starts within the handshake deadline, stalls mid-line, overruns
+    ``max_line`` without a newline, or fails to parse as JSON (the error
+    is still answered) books strikes toward a temporary ban.  Pass a
+    configured guard to tune, or ``guard=False`` for the pre-hardening
+    serial behavior."""
 
     def __init__(
         self,
@@ -51,8 +62,14 @@ class ControlServer:
         *,
         host: str = "127.0.0.1",
         port: int = 0,
+        guard=None,
+        max_line: int = _MAX_LINE,
     ) -> None:
         self._handlers = dict(handlers)
+        if guard is None:
+            guard = ListenerGuard(name="control")
+        self.guard = guard or None
+        self._max_line = max_line
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.2)
         self.address: Tuple[str, int] = self._sock.getsockname()[:2]
@@ -72,16 +89,78 @@ class ControlServer:
                 continue
             except OSError:
                 return
+            addr = "?"
             try:
-                with conn:
-                    conn.settimeout(5.0)
-                    line = _read_line(conn)
-                    if line is None:
-                        continue
-                    reply = self._handle(line)
-                    conn.sendall(reply + b"\n")
+                addr = conn.getpeername()[0]
             except OSError:
-                continue  # dead prober; keep serving
+                pass
+            guard = self.guard
+            if guard is not None and not guard.admit(addr):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            threading.Thread(
+                target=self._serve_conn, args=(conn, addr),
+                name=f"deploy-control-serve-{self.address[1]}", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket, addr: str) -> None:
+        guard = self.guard
+        try:
+            with conn:
+                line = self._read_request(conn, addr)
+                if line is None:
+                    return
+                try:
+                    json.loads(line)
+                except ValueError:
+                    # Strike the garbage but STILL answer the structured
+                    # error — the control plane never goes silent on a
+                    # merely confused prober.
+                    if guard is not None:
+                        guard.strike(addr, "garbage")
+                reply = self._handle(line)
+                conn.settimeout(5.0)
+                conn.sendall(reply + b"\n")
+        except OSError:
+            pass  # dead prober; keep serving
+        finally:
+            if guard is not None:
+                guard.release(addr)
+
+    def _read_request(self, conn: socket.socket, addr: str) -> Optional[bytes]:
+        """One newline-terminated request with guard deadlines: the first
+        byte must arrive within the handshake deadline, later chunks within
+        the progress deadline, and the line must fit ``max_line``."""
+        guard = self.guard
+        first_deadline = (
+            guard.handshake_timeout if guard is not None else 5.0
+        )
+        progress = guard.progress_timeout if guard is not None else 5.0
+        buf = b""
+        while len(buf) < self._max_line:
+            try:
+                conn.settimeout(progress if buf else first_deadline)
+                part = conn.recv(65536)
+            except socket.timeout:
+                if guard is not None:
+                    if buf:
+                        guard.strike(addr, "stall")
+                    else:
+                        guard.handshake_timed_out(addr)
+                return None
+            except OSError:
+                return None
+            if not part:
+                return None
+            buf += part
+            if b"\n" in buf:
+                return buf.split(b"\n", 1)[0]
+        if guard is not None:
+            guard.strike(addr, "oversized")
+        return None
 
     def _handle(self, line: bytes) -> bytes:
         try:

@@ -52,6 +52,20 @@ NET_RECONNECT_KEYS = (
     NET_SEND_RETRIED_KEY, NET_SEND_DROPPED_KEY,
 )
 
+#: Pinned instrument names for the listener-hardening layer
+#: (consensus_tpu/net/framing.py): every guard defense event is
+#: triple-booked — one of these counters, a ``net.abuse`` trace instant,
+#: and the ``wire_abuse`` obs detector.  ``net_malformed_total`` carries a
+#: ``kind`` label drawn from framing.MALFORMED_KINDS.
+NET_MALFORMED_KEY = "net_malformed_total"
+NET_HANDSHAKE_TIMEOUT_KEY = "net_handshake_timeout_total"
+NET_PEER_BANNED_KEY = "net_peer_banned_total"
+NET_CONN_REJECTED_KEY = "net_conn_rejected_total"
+NET_ABUSE_KEYS = (
+    NET_MALFORMED_KEY, NET_HANDSHAKE_TIMEOUT_KEY,
+    NET_PEER_BANNED_KEY, NET_CONN_REJECTED_KEY,
+)
+
 #: Pinned instrument names for the observability plane (consensus_tpu/obs/).
 #: One counter per anomaly detector — the sampler bumps the affected node's
 #: counter the moment a detector fires (edge-triggered), mirrored by an
@@ -70,6 +84,7 @@ OBS_ANOMALY_ENGINE_DEGRADED_KEY = "obs_anomaly_engine_degraded"
 OBS_ANOMALY_WAL_CORRUPTION_KEY = "obs_anomaly_wal_corruption"
 OBS_ANOMALY_WAL_STALL_KEY = "obs_anomaly_wal_stall"
 OBS_ANOMALY_CROSS_GROUP_STALL_KEY = "obs_anomaly_cross_group_stall"
+OBS_ANOMALY_WIRE_ABUSE_KEY = "obs_anomaly_wire_abuse"
 OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_COMMIT_STALL_KEY,
     OBS_ANOMALY_VIEW_CHANGE_STORM_KEY,
@@ -83,6 +98,7 @@ OBS_ANOMALY_KEYS = (
     OBS_ANOMALY_WAL_CORRUPTION_KEY,
     OBS_ANOMALY_WAL_STALL_KEY,
     OBS_ANOMALY_CROSS_GROUP_STALL_KEY,
+    OBS_ANOMALY_WIRE_ABUSE_KEY,
 )
 
 #: Pinned instrument names for durable-state self-healing (wal/scrub.py,
@@ -259,6 +275,18 @@ PINNED_METRIC_KEYS: dict[str, str] = {
     NET_SEND_DROPPED_KEY:
         "frames dropped after exhausting connect/send retries "
         "(fire-and-forget contract)",
+    NET_MALFORMED_KEY:
+        "provably-malformed inbound frames booked as strikes "
+        "(kind label: oversized/bad_hello/pre_hello/sender_pin/stall/garbage)",
+    NET_HANDSHAKE_TIMEOUT_KEY:
+        "inbound connections dropped for never completing HELLO/HMAC "
+        "within the handshake deadline",
+    NET_PEER_BANNED_KEY:
+        "peers temporarily banned after crossing the malformed-frame "
+        "strike limit",
+    NET_CONN_REJECTED_KEY:
+        "inbound connections refused at accept (active ban or a "
+        "per-peer/global quota full)",
     OBS_SAMPLES_KEY: "observability-plane samples taken",
     OBS_ANOMALY_COMMIT_STALL_KEY:
         "detector firings: pending work but no ledger growth",
@@ -289,6 +317,10 @@ PINNED_METRIC_KEYS: dict[str, str] = {
     OBS_ANOMALY_CROSS_GROUP_STALL_KEY:
         "detector firings: a cross-group atomic transaction stuck "
         "unresolved past the stall window",
+    OBS_ANOMALY_WIRE_ABUSE_KEY:
+        "detector firings: a listener booked new abuse events (malformed "
+        "strikes, handshake timeouts, bans, quota rejects) since the last "
+        "sample",
     WAL_FSYNC_RETRY_KEY:
         "group-commit fsync attempts that failed and were re-armed",
     WAL_SCRUB_RUNS_KEY:
@@ -866,6 +898,30 @@ class MetricsNetwork(_Bundle):
             "Frames dropped after exhausting connect/send retries.",
             ln,
         )
+        # Listener-hardening guard (net/framing.py): a ListenerGuard with
+        # this bundle attached books every defense event here.  The
+        # malformed counter carries a "kind" label (framing.MALFORMED_KINDS)
+        # so with_labels(kind) yields per-kind child series.
+        self.count_malformed = p.new_counter(
+            NET_MALFORMED_KEY,
+            "Provably-malformed inbound frames booked as strikes.",
+            extend_label_names(("kind",), label_names),
+        )
+        self.count_handshake_timeout = p.new_counter(
+            NET_HANDSHAKE_TIMEOUT_KEY,
+            "Inbound connections dropped for never completing the handshake.",
+            ln,
+        )
+        self.count_peer_banned = p.new_counter(
+            NET_PEER_BANNED_KEY,
+            "Peers temporarily banned after crossing the strike limit.",
+            ln,
+        )
+        self.count_conn_rejected = p.new_counter(
+            NET_CONN_REJECTED_KEY,
+            "Inbound connections refused at accept (ban or quota).",
+            ln,
+        )
 
 
 class MetricsObs(_Bundle):
@@ -940,6 +996,12 @@ class MetricsObs(_Bundle):
             OBS_ANOMALY_CROSS_GROUP_STALL_KEY,
             "Cross-group-stall detector firings (a 2PC transaction stuck "
             "unresolved past the stall window).",
+            ln,
+        )
+        self.count_anomaly_wire_abuse = p.new_counter(
+            OBS_ANOMALY_WIRE_ABUSE_KEY,
+            "Wire-abuse detector firings (a listener booked new guard "
+            "defense events since the last sample).",
             ln,
         )
 
@@ -1260,6 +1322,11 @@ __all__ = [
     "NET_SEND_RETRIED_KEY",
     "NET_SEND_DROPPED_KEY",
     "NET_RECONNECT_KEYS",
+    "NET_MALFORMED_KEY",
+    "NET_HANDSHAKE_TIMEOUT_KEY",
+    "NET_PEER_BANNED_KEY",
+    "NET_CONN_REJECTED_KEY",
+    "NET_ABUSE_KEYS",
     "OBS_SAMPLES_KEY",
     "OBS_ANOMALY_COMMIT_STALL_KEY",
     "OBS_ANOMALY_VIEW_CHANGE_STORM_KEY",
@@ -1273,6 +1340,7 @@ __all__ = [
     "OBS_ANOMALY_WAL_CORRUPTION_KEY",
     "OBS_ANOMALY_WAL_STALL_KEY",
     "OBS_ANOMALY_CROSS_GROUP_STALL_KEY",
+    "OBS_ANOMALY_WIRE_ABUSE_KEY",
     "OBS_ANOMALY_KEYS",
     "WAL_FSYNC_RETRY_KEY",
     "WAL_SCRUB_RUNS_KEY",

@@ -85,6 +85,7 @@ import numpy as np
 # jax-free (models/engine.py is pure numpy/threading), so importing the
 # sidecar module still never drags in the accelerator stack.
 from consensus_tpu.models.engine import AdmissionReject as _AdmissionReject
+from consensus_tpu.net.framing import RECV_CHUNK_BYTES, ListenerGuard
 
 logger = logging.getLogger("consensus_tpu.net.sidecar")
 
@@ -171,11 +172,25 @@ def _frame_mac(key: bytes, direction: bytes, req_id: int, payload: bytes) -> byt
     return _hmac256(key, direction, req_id.to_bytes(8, "big"), payload)[:_MAC_LEN]
 
 
+class _MidFrameStall(ConnectionError):
+    """A peer stopped sending mid-frame (the server books a ``stall``)."""
+
+
+class _FrameTooLarge(ConnectionError):
+    """A peer claimed a frame beyond the cap (booked as ``oversized``)."""
+
+
+class _MacMismatch(ConnectionError):
+    """A frame MAC failed verification (booked as ``bad_hello``)."""
+
+
 def _recv_exact(sock: socket.socket, n: int, patient: bool = False) -> bytes:
     buf = bytearray()
     while len(buf) < n:
         try:
-            chunk = sock.recv(n - len(buf))
+            # Chunked (cap-check-before-allocate): allocation tracks bytes
+            # actually received, never the peer's claimed length.
+            chunk = sock.recv(min(n - len(buf), RECV_CHUNK_BYTES))
         except TimeoutError:
             if patient:
                 # The CLIENT reader trusts its one sidecar and must not
@@ -188,7 +203,7 @@ def _recv_exact(sock: socket.socket, n: int, patient: bool = False) -> bytes:
                 # A stall MID-frame loses protocol sync; only an idle
                 # timeout at a frame boundary is benign (re-raised for the
                 # caller to swallow).
-                raise ConnectionError("sidecar stalled mid-frame")
+                raise _MidFrameStall("sidecar stalled mid-frame")
             raise
         if not chunk:
             raise ConnectionError("sidecar connection closed")
@@ -209,7 +224,7 @@ def _read_frame(
     header = _recv_exact(sock, _FRAME.size, patient)
     length, req_id = _FRAME.unpack(header)
     if length > max_frame:
-        raise ConnectionError(f"sidecar frame too large: {length}")
+        raise _FrameTooLarge(f"sidecar frame too large: {length}")
     try:
         payload = _recv_exact(sock, length, patient)
         if mac_key is not None:
@@ -217,9 +232,9 @@ def _read_frame(
             if not hmac.compare_digest(
                 mac, _frame_mac(mac_key, direction, req_id, payload)
             ):
-                raise ConnectionError("sidecar frame MAC mismatch")
+                raise _MacMismatch("sidecar frame MAC mismatch")
     except TimeoutError:
-        raise ConnectionError("sidecar stalled mid-frame") from None
+        raise _MidFrameStall("sidecar stalled mid-frame") from None
     return req_id, payload
 
 
@@ -285,7 +300,16 @@ class VerifySidecarServer:
     READING its responses stalls a worker's send for at most this long,
     after which the connection is torn down and its worker slots recovered —
     otherwise a connect-flood-abandon peer would park ``max_inflight``
-    threads per connection forever."""
+    threads per connection forever.
+
+    ``guard``: hardened DEFAULT-ON via a :class:`~consensus_tpu.net.framing
+    .ListenerGuard` — per-peer/global connection quotas checked at accept
+    (before the handshake spends a nonce), plus strikes toward a temporary
+    ban for provably-malformed traffic: a failed auth proof or frame-MAC
+    mismatch (``bad_hello``), an oversized length claim, a mid-frame stall.
+    A peer that connects and never attempts the handshake books a
+    handshake timeout.  Pass a configured guard to tune, or ``guard=False``
+    for the pre-hardening behavior."""
 
     def __init__(
         self,
@@ -302,10 +326,14 @@ class VerifySidecarServer:
         tenant_queue_limit: int = 4096,
         metrics=None,
         tenant_accounting=None,
+        guard=None,
     ) -> None:
         self._address = address
         self._engine = engine
         self._secret = auth_secret
+        if guard is None:
+            guard = ListenerGuard(name="sidecar")
+        self.guard = guard or None
         self._tenants = dict(tenants) if tenants else None
         self._max_inflight = max_inflight
         self._max_frame = max_frame
@@ -398,17 +426,31 @@ class VerifySidecarServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            addr = "local"  # AF_UNIX peers have no address; quota them as one
             if conn.family == socket.AF_INET:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    addr = conn.getpeername()[0]
+                except OSError:
+                    addr = "?"
+            guard = self.guard
+            if guard is not None and not guard.admit(addr):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             # Daemon threads, deliberately untracked: connections churn for
             # the life of the sidecar and holding dead Thread objects would
             # grow without bound; stop() only needs the listener.
             threading.Thread(
-                target=self._serve_conn, args=(conn,), daemon=True,
+                target=self._serve_conn, args=(conn, addr), daemon=True,
                 name="sidecar-conn",
             ).start()
 
-    def _handshake(self, conn: socket.socket) -> Optional[tuple[bytes, str]]:
+    def _handshake(
+        self, conn: socket.socket, addr: str = "?"
+    ) -> Optional[tuple[bytes, str]]:
         """MUTUAL challenge-response: the peer proves knowledge of A secret
         over (server_nonce, client_nonce), the server proves it back, and
         both derive the per-connection session key that MACs every frame.
@@ -418,7 +460,10 @@ class VerifySidecarServer:
         WHICH secret validates the proof (the tenant id is bound inside the
         HMACs, not sent in clear).  Runs under a deadline so an idle
         connect cannot park a thread."""
-        conn.settimeout(_HANDSHAKE_TIMEOUT)
+        conn.settimeout(
+            self.guard.handshake_timeout
+            if self.guard is not None else _HANDSHAKE_TIMEOUT
+        )
         try:
             server_nonce = os.urandom(_NONCE_LEN)
             conn.sendall(server_nonce)
@@ -463,26 +508,40 @@ class VerifySidecarServer:
                         )
                         break
             if matched is None:
+                # A wrong proof (wrong secret, or a replayed transcript
+                # against this connection's fresh nonce) is provably
+                # malformed: strike toward a ban.
+                if self.guard is not None:
+                    self.guard.strike(addr, "bad_hello")
                 logger.warning("sidecar: rejected peer with bad auth answer")
                 return None
             _, tenant, server_proof, session_key = matched
             conn.sendall(server_proof)
             return session_key, tenant
+        except socket.timeout:
+            # Connect-and-idle: the peer never attempted the handshake.
+            if self.guard is not None:
+                self.guard.handshake_timed_out(addr)
+            logger.warning("sidecar: peer failed to complete auth handshake")
+            return None
         except (ConnectionError, OSError):
+            # EOF mid-handshake: a crashed honest client looks the same, so
+            # this path books nothing (quotas still bound connect-floods).
             logger.warning("sidecar: peer failed to complete auth handshake")
             return None
 
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _serve_conn(self, conn: socket.socket, addr: str = "local") -> None:
         write_lock = threading.Lock()
         # Per-connection in-flight bound: acquire before dispatch, release
         # when the worker answers; a saturated peer blocks HERE (TCP
         # backpressure) instead of growing the thread count.
         slots = threading.BoundedSemaphore(self._max_inflight)
+        guard = self.guard
         mac_key: Optional[bytes] = None
         tenant = ""
         try:
             if self._secret is not None or self._tenants is not None:
-                outcome = self._handshake(conn)
+                outcome = self._handshake(conn, addr)
                 if outcome is None:
                     return
                 mac_key, tenant = outcome
@@ -494,6 +553,18 @@ class VerifySidecarServer:
                     req_id, payload = _read_frame(
                         conn, self._max_frame, mac_key, b"c2s"
                     )
+                except _FrameTooLarge:
+                    if guard is not None:
+                        guard.strike(addr, "oversized")
+                    return
+                except _MacMismatch:
+                    if guard is not None:
+                        guard.strike(addr, "bad_hello")
+                    return
+                except _MidFrameStall:
+                    if guard is not None:
+                        guard.strike(addr, "stall")
+                    return
                 except TimeoutError:
                     continue  # idle peer at a frame boundary
                 slots.acquire()
@@ -509,6 +580,8 @@ class VerifySidecarServer:
         except (ConnectionError, OSError):
             pass
         finally:
+            if guard is not None:
+                guard.release(addr)
             try:
                 conn.close()
             except OSError:

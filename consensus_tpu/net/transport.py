@@ -54,6 +54,7 @@ import threading
 from typing import Callable, Mapping, Optional, Sequence, Tuple
 
 from consensus_tpu.api.deps import Comm
+from consensus_tpu.net.framing import FrameStall, ListenerGuard, recv_exact
 from consensus_tpu.wire import ConsensusMessage, decode_message, encode_message
 
 logger = logging.getLogger("consensus_tpu.net")
@@ -102,6 +103,7 @@ class TcpComm(Comm):
         auth_secret: Optional[bytes] = None,
         metrics=None,
         fault_plan=None,
+        guard=None,
     ) -> None:
         #: Optional testing FaultPlan (consensus_tpu/testing/faults.py):
         #: arms the net.send.io_error / net.recv.short_read seams below.
@@ -119,6 +121,14 @@ class TcpComm(Comm):
         self._send_retries = max(0, send_retries)
         self._connect_timeout = connect_timeout
         self._auth_secret = auth_secret
+        #: Listener hardening (net/framing.py), DEFAULT-ON: quotas at
+        #: accept, handshake + mid-frame progress deadlines, strike/ban
+        #: accounting.  Pass a configured :class:`ListenerGuard` to tune,
+        #: or ``guard=False`` for the pre-hardening listener (bench
+        #: baseline only — honest traffic behaves identically either way).
+        if guard is None:
+            guard = ListenerGuard(name=f"comm-{self_id}", metrics=metrics)
+        self.guard: Optional[ListenerGuard] = guard or None
         # One-slot encode memo: broadcasts send the same message object to
         # n-1 peers back to back; encode it once (single-threaded caller).
         self._encode_memo: tuple[Optional[object], bytes] = (None, b"")
@@ -314,25 +324,54 @@ class TcpComm(Comm):
                 conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             except OSError:
                 pass
+            addr = "?"
+            try:
+                addr = conn.getpeername()[0]
+            except OSError:
+                pass
+            guard = self.guard
+            if guard is not None and not guard.admit(addr):
+                # Banned peer or full quota: refuse before reading a byte.
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             with self._inbound_lock:
                 if self._stopped.is_set():
                     conn.close()
+                    if guard is not None:
+                        guard.release(addr)
                     return
                 self._inbound.add(conn)
             threading.Thread(
                 target=self._receive_loop,
-                args=(conn,),
+                args=(conn, addr),
                 name=f"comm-{self.self_id}-recv",
                 daemon=True,
             ).start()
 
-    def _receive_loop(self, conn: socket.socket) -> None:
+    def _receive_loop(self, conn: socket.socket, addr: str = "?") -> None:
         pinned_sender: Optional[int] = None
+        guard = self.guard
+
+        def strike(kind: str) -> None:
+            if guard is not None:
+                guard.strike(addr, kind)
+
         # Challenge: a fresh nonce per connection (replay protection).
         nonce = os.urandom(_NONCE_BYTES)
         try:
             conn.sendall(_HEADER.pack(len(nonce), self.self_id, _KIND_HELLO) + nonce)
         except OSError:
+            with self._inbound_lock:
+                self._inbound.discard(conn)
+            if guard is not None:
+                guard.release(addr)
+            try:
+                conn.close()
+            except OSError:
+                pass
             return
         try:
             while not self._stopped.is_set():
@@ -342,14 +381,51 @@ class TcpComm(Comm):
                     # closes the connection exactly as a real short read
                     # below would; the sender reconnects lazily.
                     return
-                header = _read_exact(conn, _HEADER.size)
+                # Until the HELLO pins an identity, every read runs under
+                # the handshake deadline; after it, the header read waits
+                # patiently (an idle honest peer) but any started frame
+                # must keep making progress (slow-loris defense).
+                if guard is None:
+                    timeout, patient, preset = None, False, False
+                elif pinned_sender is None:
+                    timeout, patient, preset = (
+                        guard.handshake_timeout, False, False
+                    )
+                else:
+                    # Pinned connections read non-blocking (set below):
+                    # preset reads try recv first and enforce the
+                    # progress deadline only when a read actually blocks.
+                    timeout, patient, preset = (
+                        guard.progress_timeout, True, True
+                    )
+                try:
+                    header = recv_exact(
+                        conn, _HEADER.size,
+                        progress_timeout=timeout, patient_first=patient,
+                        preset=preset,
+                    )
+                except FrameStall as stall:
+                    if pinned_sender is None and stall.received == 0:
+                        # Never sent a byte: connect-and-idle, not a frame.
+                        if guard is not None:
+                            guard.handshake_timed_out(addr)
+                    else:
+                        strike("stall")
+                    return
                 if header is None:
                     return
                 length, sender, kind = _HEADER.unpack(header)
                 if length > MAX_FRAME_BYTES:
                     logger.warning("oversized frame from %d; dropping link", sender)
+                    strike("oversized")
                     return
-                payload = _read_exact(conn, length)
+                try:
+                    payload = recv_exact(
+                        conn, length, progress_timeout=timeout, preset=preset,
+                    )
+                except FrameStall:
+                    strike("stall")
+                    return
                 if payload is None:
                     return
                 if pinned_sender is None:
@@ -360,6 +436,7 @@ class TcpComm(Comm):
                             "%d: connection sent %d before HELLO; dropping link",
                             self.self_id, kind,
                         )
+                        strike("pre_hello")
                         return
                     expected = _hello_proof(self._auth_secret, nonce, sender)
                     if not hmac.compare_digest(payload, expected):
@@ -367,19 +444,32 @@ class TcpComm(Comm):
                             "%d: bad HELLO proof for claimed sender %d; dropping link",
                             self.self_id, sender,
                         )
+                        strike("bad_hello")
                         return
                     pinned_sender = sender
+                    if guard is not None:
+                        # Pinned: go non-blocking for the connection's
+                        # lifetime — preset reads try recv first and pay
+                        # for a readiness wait only when a read actually
+                        # blocks, so honest line rate matches unguarded.
+                        try:
+                            conn.setblocking(False)
+                        except OSError:
+                            return
                     continue
                 if sender != pinned_sender:
                     logger.warning(
                         "%d: frame claims sender %d on connection pinned to %d; dropping link",
                         self.self_id, sender, pinned_sender,
                     )
+                    strike("sender_pin")
                     return
                 self._dispatch(sender, kind, payload)
         finally:
             with self._inbound_lock:
                 self._inbound.discard(conn)
+            if guard is not None:
+                guard.release(addr)
             try:
                 conn.close()
             except OSError:
@@ -495,13 +585,13 @@ class _Peer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # Read the acceptor's challenge nonce, answer with the proof.
                 sock.settimeout(comm._connect_timeout)
-                header = _read_exact(sock, _HEADER.size)
+                header = recv_exact(sock, _HEADER.size)
                 if header is None:
                     raise OSError("peer closed during handshake")
                 length, _, kind = _HEADER.unpack(header)
                 if kind != _KIND_HELLO or length != _NONCE_BYTES:
                     raise OSError("bad handshake challenge")
-                nonce = _read_exact(sock, length)
+                nonce = recv_exact(sock, length)
                 if nonce is None:
                     raise OSError("peer closed during handshake")
                 sock.settimeout(None)
@@ -531,19 +621,6 @@ class _Peer:
             except OSError:
                 pass
             self._sock = None
-
-
-def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-    buf = b""
-    while len(buf) < n:
-        try:
-            chunk = conn.recv(n - len(buf))
-        except OSError:
-            return None
-        if not chunk:
-            return None
-        buf += chunk
-    return buf
 
 
 __all__ = ["TcpComm", "MAX_FRAME_BYTES"]

@@ -6,7 +6,7 @@ fixed-seed run is byte-identical across replays.  Detectors are
 EDGE-TRIGGERED: a condition fires once at onset and re-arms only after the
 condition clears, so a 300-second stall is one anomaly, not 300.
 
-The twelve kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
+The thirteen kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
 
 ``commit_stall``        a running node has pending pool work but its ledger
                         has not grown for ``stall_window`` sim-seconds
@@ -56,6 +56,14 @@ The twelve kinds (pinned metric names: metrics.OBS_ANOMALY_KEYS):
                         the groups harness via the optional
                         ``groups_twopc_oldest_age`` health field); clears
                         when the oldest in-flight transaction resolves
+``wire_abuse``          the node's listener guard (net/framing.py) booked
+                        ``wire_abuse_events``+ NEW defense events —
+                        malformed-frame strikes, handshake timeouts, bans,
+                        quota rejects — since the last sample (fed via the
+                        optional ``net_malformed`` / ``net_handshake_timeouts``
+                        / ``net_peer_bans`` / ``net_conn_rejected`` health
+                        fields a ``wire_guard``-carrying node reports);
+                        clears on the first sample with no new events
 
 The two ingress detectors read OPTIONAL health fields
 (``ingress_offered`` / ``ingress_rate_limited`` / ``ingress_dedup_hits``,
@@ -86,6 +94,7 @@ ANOMALY_KINDS = (
     "wal_corruption",
     "wal_stall",
     "cross_group_stall",
+    "wire_abuse",
 )
 
 
@@ -107,6 +116,7 @@ class DetectorThresholds:
     dedup_min_offered: int = 20
     dedup_hit_fraction: float = 0.5
     cross_group_stall_window: float = 60.0
+    wire_abuse_events: int = 1
 
     def validate(self) -> None:
         if self.stall_window <= 0 or self.storm_window <= 0 or self.flap_window <= 0:
@@ -117,7 +127,8 @@ class DetectorThresholds:
                self.lag_decisions, self.collapse_decisions,
                self.churn_epochs) < 1:
             raise ValueError("detector counts must be >= 1")
-        if min(self.overload_min_offered, self.dedup_min_offered) < 1:
+        if min(self.overload_min_offered, self.dedup_min_offered,
+               self.wire_abuse_events) < 1:
             raise ValueError("detector counts must be >= 1")
         if not (0.0 < self.overload_reject_fraction <= 1.0
                 and 0.0 < self.dedup_hit_fraction <= 1.0):
@@ -148,7 +159,7 @@ class _NodeState:
     __slots__ = (
         "stall_since", "last_ledger", "view_changes", "leader_changes",
         "last_view", "last_leader", "collapse_base",
-        "epoch_changes", "last_epoch", "ingress_base",
+        "epoch_changes", "last_epoch", "ingress_base", "wire_abuse_base",
     )
 
     def __init__(self) -> None:
@@ -164,6 +175,9 @@ class _NodeState:
         #: Previous sample's cumulative (offered, rate_limited, dedup_hits)
         #: — the ingress detectors fire on PER-SAMPLE deltas.
         self.ingress_base: Optional[tuple[int, int, int]] = None
+        #: Previous sample's cumulative listener-guard event total — the
+        #: wire_abuse detector fires on PER-SAMPLE deltas.
+        self.wire_abuse_base: Optional[int] = None
 
 
 class DetectorBank:
@@ -365,6 +379,32 @@ class DetectorBank:
                     twopc_age >= th.cross_group_stall_window,
                     f"oldest cross-group transaction unresolved for "
                     f"{twopc_age:g}s (window {th.cross_group_stall_window:g}s)",
+                )
+
+            # --- wire abuse (listener guard deltas) --------------------
+            malformed = h.get("net_malformed")
+            if malformed is None:
+                # No listener guard on this node: discard the latch so
+                # pre-hardening health streams stay byte-identical.
+                st.wire_abuse_base = None
+                self._active.discard(("wire_abuse", nid))
+            else:
+                total = (
+                    malformed
+                    + h.get("net_handshake_timeouts", 0)
+                    + h.get("net_peer_bans", 0)
+                    + h.get("net_conn_rejected", 0)
+                )
+                if st.wire_abuse_base is None:
+                    st.wire_abuse_base = 0
+                delta = total - st.wire_abuse_base
+                st.wire_abuse_base = total
+                self._edge(
+                    fired, "wire_abuse", nid, t,
+                    delta >= th.wire_abuse_events,
+                    f"listener guard booked {delta} abuse events since the "
+                    f"last sample ({total} cumulative: {malformed} malformed, "
+                    f"{h.get('net_peer_bans', 0)} bans)",
                 )
 
             # --- verify-launch-rate collapse ---------------------------

@@ -16,11 +16,21 @@ from typing import Iterable, Optional
 
 _NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
+#: Health fields a node reports only when the corresponding surface
+#: exists — the listener guard's counters appear once a ``wire_guard``
+#: is attached (hardened listeners, the chaos ``net_abuse`` arm) and
+#: never before, so pre-hardening scrapes stay byte-identical.
+OPTIONAL_HEALTH_FIELDS = (
+    "net_malformed", "net_handshake_timeouts", "net_peer_bans",
+    "net_conn_rejected",
+)
+
 #: Health fields exported as ``obs_health_<field>{node="..."}`` gauges.
+#: The :data:`OPTIONAL_HEALTH_FIELDS` tail is emitted only when present.
 HEALTH_FIELDS = (
     "running", "view", "leader", "seq", "in_flight", "syncing",
     "pool", "wal_entries", "wal_fsyncs", "ledger", "sync_lag", "epoch",
-)
+) + OPTIONAL_HEALTH_FIELDS
 
 
 def _fmt_value(v) -> str:

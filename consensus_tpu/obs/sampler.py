@@ -205,6 +205,16 @@ class ClusterSampler:
         # Optional durable-storage surface: only file-backed WALs carry a
         # degraded flag (MemWAL does not), so pre-storage samples stay
         # byte-identical.
+        # Optional listener-guard surface: only nodes carrying a wire_guard
+        # (hardened listeners, or the chaos net_abuse arm) report it, so
+        # pre-hardening samples stay byte-identical.
+        guard = getattr(node, "wire_guard", None)
+        if guard is not None:
+            stats = guard.stats
+            h["net_malformed"] = int(stats.malformed)
+            h["net_handshake_timeouts"] = int(stats.handshake_timeouts)
+            h["net_peer_bans"] = int(stats.bans)
+            h["net_conn_rejected"] = int(stats.rejected)
         wal_deg = getattr(wal, "degraded", None)
         if wal_deg is not None:
             h["wal_degraded"] = bool(wal_deg)

@@ -32,6 +32,7 @@ import struct
 import threading
 from typing import Dict, Optional, Sequence, Union
 
+from consensus_tpu.net.framing import FrameStall, ListenerGuard, recv_exact
 from consensus_tpu.sync.server import SyncServer
 from consensus_tpu.wire.codec import CodecError, decode_message, encode_message
 from consensus_tpu.wire.messages import SyncChunk, SyncRequest, SyncSnapshotMeta
@@ -117,10 +118,30 @@ class InProcessSyncTransport(SyncTransport):
 class SyncListener:
     """Serves a :class:`SyncServer` over TCP: one framed request, one framed
     reply per connection (catch-up is bursty and rare; connection reuse is
-    not worth the state).  Daemon accept thread; ``close()`` stops it."""
+    not worth the state).  Daemon accept thread; ``close()`` stops it.
 
-    def __init__(self, server: SyncServer, *, host: str = "127.0.0.1", port: int = 0) -> None:
+    Hardened DEFAULT-ON via a :class:`~consensus_tpu.net.framing
+    .ListenerGuard`: connections are admitted against per-peer/global
+    quotas before a byte is read, each is served on its own daemon thread
+    (one slow-loris peer no longer blocks honest catch-up behind it), the
+    first frame must start within the guard's handshake deadline, started
+    frames must keep making progress, and malformed frames (oversized
+    claim, stall, undecodable request) accrue strikes toward a temporary
+    ban.  Pass a configured guard to tune, or ``guard=False`` for the
+    pre-hardening serial listener behavior."""
+
+    def __init__(
+        self,
+        server: SyncServer,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        guard=None,
+    ) -> None:
         self.server = server
+        if guard is None:
+            guard = ListenerGuard(name="sync")
+        self.guard = guard or None
         self._sock = socket.create_server((host, port))
         self._sock.settimeout(0.2)
         self.address = self._sock.getsockname()
@@ -139,16 +160,71 @@ class SyncListener:
                 continue
             except OSError:
                 return
+            addr = "?"
             try:
-                with conn:
-                    conn.settimeout(5.0)
-                    raw = _read_frame(conn)
-                    if raw is None:
-                        continue
+                addr = conn.getpeername()[0]
+            except OSError:
+                pass
+            guard = self.guard
+            if guard is not None and not guard.admit(addr):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            threading.Thread(
+                target=self._serve_conn, args=(conn, addr),
+                name=f"sync-serve-{self.address[1]}", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket, addr: str) -> None:
+        guard = self.guard
+        first_deadline = (
+            guard.handshake_timeout if guard is not None else 5.0
+        )
+        progress = guard.progress_timeout if guard is not None else 5.0
+        try:
+            with conn:
+                try:
+                    header = recv_exact(
+                        conn, _FRAME.size, progress_timeout=first_deadline
+                    )
+                except FrameStall as stall:
+                    if guard is not None:
+                        if stall.received == 0:
+                            # Connect-and-idle: never started a frame.
+                            guard.handshake_timed_out(addr)
+                        else:
+                            guard.strike(addr, "stall")
+                    return
+                if header is None:
+                    return
+                (length,) = _FRAME.unpack(header)
+                if length > _MAX_FRAME_BYTES:
+                    if guard is not None:
+                        guard.strike(addr, "oversized")
+                    return
+                try:
+                    raw = recv_exact(conn, length, progress_timeout=progress)
+                except FrameStall:
+                    if guard is not None:
+                        guard.strike(addr, "stall")
+                    return
+                if raw is None:
+                    return
+                try:
                     reply = self.server.handle_bytes(raw)
-                    conn.sendall(_FRAME.pack(len(reply)) + reply)
-            except (OSError, CodecError):
-                continue  # bad client; keep serving others
+                except CodecError:
+                    if guard is not None:
+                        guard.strike(addr, "garbage")
+                    return
+                conn.settimeout(5.0)
+                conn.sendall(_FRAME.pack(len(reply)) + reply)
+        except OSError:
+            pass  # bad client; keep serving others
+        finally:
+            if guard is not None:
+                guard.release(addr)
 
     def close(self) -> None:
         self._closed.set()
@@ -160,34 +236,19 @@ class SyncListener:
 
 
 def _read_frame(conn: socket.socket) -> Optional[bytes]:
-    header = _read_exact(conn, _FRAME.size)
+    """Client-side framed read (the fetch reply path): cap check BEFORE
+    any payload buffering, then the shared chunked
+    :func:`~consensus_tpu.net.framing.recv_exact` — allocation tracks
+    bytes actually received, never the peer's claimed length.  EOF,
+    ECONNRESET, and timeouts all collapse to None (the fetch yielded
+    nothing; the connection is dropped)."""
+    header = recv_exact(conn, _FRAME.size)
     if header is None:
         return None
     (length,) = _FRAME.unpack(header)
     if length > _MAX_FRAME_BYTES:
         raise CodecError(f"sync frame of {length} bytes exceeds cap")
-    return _read_exact(conn, length)
-
-
-def _read_exact(conn: socket.socket, n: int) -> Optional[bytes]:
-    """Read exactly ``n`` bytes or fail CLEANLY with None.
-
-    A peer killed mid-frame (kill -9, RST, or a stall past the socket
-    timeout) must never hang the listener thread or hand a truncated
-    buffer to the codec: EOF, ECONNRESET, and timeouts all collapse to
-    None here, and every caller treats None as "this fetch yielded
-    nothing" — the chunk is not applied, the connection is dropped, and
-    the listener keeps serving other peers."""
-    buf = b""
-    while len(buf) < n:
-        try:
-            part = conn.recv(n - len(buf))
-        except OSError:  # includes socket.timeout: bounded, never a hang
-            return None
-        if not part:
-            return None
-        buf += part
-    return buf
+    return recv_exact(conn, length)
 
 
 class TcpSyncTransport(SyncTransport):

@@ -100,6 +100,28 @@ Adversary vocabulary (``ChaosAction.kind``):
                                 ``generate(storage_faults=False)`` consumes
                                 no extra RNG, so pinned schedules replay
                                 byte-identically.
+``net_abuse``                   adversarial-network vocabulary
+                                (``generate(adversarial_net=True)`` only):
+                                a byzantine wire peer abuses one node's
+                                listener guard (net/framing.py) — a
+                                slow-loris stall flood, a malformed-frame
+                                flood, or a connect flood past the
+                                per-peer quota.  The sim arm drives a
+                                :class:`~consensus_tpu.net.framing
+                                .ListenerGuard` directly on the sim clock
+                                (scripted, zero sockets, byte-
+                                deterministic); the REAL-socket
+                                equivalent of the same vocabulary is
+                                ``testing/adversary.py``, run tier-1
+                                against live listeners and by the deploy
+                                rig.  The guard surfaces on the node as
+                                ``wire_guard`` so the obs sampler exports
+                                its counters and the ``wire_abuse``
+                                detector fires; bans land in the event
+                                log and trip the flight recorder.
+                                ``generate(adversarial_net=False)``
+                                consumes no extra RNG, so pinned
+                                schedules replay byte-identically.
 
 Everything runs on the SimScheduler's virtual clock — no wall-clock reads
 anywhere (scripts/check_no_wallclock.py lints this module too).
@@ -149,6 +171,14 @@ DEVICE_FAULT_CLASSES = ("hang", "raise", "flip")
 #: file-backed WAL, only drawn when a schedule opts in.  The ``fault`` arg
 #: is one of testing/storage.py's :data:`STORAGE_FAULT_CLASSES`.
 STORAGE_FAULT_KINDS = ("storage_fault",)
+
+#: The adversarial-network vocabulary: scripted listener-guard abuse
+#: against one node's wire edge, only drawn when a schedule opts in.
+ADVERSARIAL_NET_KINDS = ("net_abuse",)
+
+#: The scripted abuse batteries a ``net_abuse`` action may run (sim-clock
+#: mirrors of the real-socket batteries in testing/adversary.py).
+NET_ABUSE_BATTERIES = ("stall_flood", "garbage_flood", "connect_flood")
 
 #: Geography bank: per-profile region names, intra-region link latency
 #: ``(base, jitter)`` in sim-seconds, and the inter-region latency matrix
@@ -276,6 +306,10 @@ class ChaosSchedule:
     #: Carried so shrunk subsets keep the file-backed cluster + scrubber
     #: even after every ``storage_fault`` action was deleted.
     storage_faults: bool = False
+    #: True when the schedule was drawn with the adversarial-network
+    #: vocabulary.  Carried so shrunk subsets stay recognizable even after
+    #: every ``net_abuse`` action was deleted.
+    adversarial_net: bool = False
 
     @classmethod
     def generate(
@@ -290,6 +324,7 @@ class ChaosSchedule:
         wan: Optional[str] = None,
         device_faults: bool = False,
         storage_faults: bool = False,
+        adversarial_net: bool = False,
     ) -> "ChaosSchedule":
         """Derive a feasible schedule from ``seed``: action times are
         cumulative uniform(5, 40) gaps from ``start``, kinds are weighted
@@ -320,7 +355,15 @@ class ChaosSchedule:
         targets share the crash budget (at most ``f`` replicas down or
         suspect at once) and each node is faulted at most once per
         schedule; ``storage_faults=False`` consumes no extra RNG, so
-        pre-storage schedules replay byte-identically."""
+        pre-storage schedules replay byte-identically.
+
+        ``adversarial_net=True`` adds ``net_abuse`` to the vocabulary: a
+        byzantine wire peer runs one scripted abuse battery
+        (:data:`NET_ABUSE_BATTERIES`) against one node's listener guard.
+        Abuse targets the wire EDGE, not the protocol, so it needs no
+        feasibility budget — a guarded listener sheds it by design;
+        ``adversarial_net=False`` consumes no extra RNG, so pre-hardening
+        schedules replay byte-identically."""
         if wan is not None and wan not in WAN_PROFILES:
             raise ValueError(
                 f"unknown WAN profile {wan!r}; "
@@ -344,6 +387,9 @@ class ChaosSchedule:
             weights += [1.5]
         if storage_faults:
             kinds += list(STORAGE_FAULT_KINDS)
+            weights += [1.5]
+        if adversarial_net:
+            kinds += list(ADVERSARIAL_NET_KINDS)
             weights += [1.5]
         members = set(ids)
         next_id = n + 1
@@ -476,6 +522,16 @@ class ChaosSchedule:
                     args={"fault": rng.choice(DEVICE_FAULT_CLASSES),
                           "launch": rng.randrange(1, 4)},
                 ))
+            elif kind == "net_abuse":
+                # Abuse hits the wire edge of one node; no feasibility
+                # budget (a guarded listener sheds it without protocol
+                # involvement, crashed targets are skipped at run time).
+                actions.append(ChaosAction(
+                    at=t, kind="net_abuse",
+                    args={"node": rng.choice(ids),
+                          "battery": rng.choice(NET_ABUSE_BATTERIES),
+                          "events": rng.randrange(3, 8)},
+                ))
             else:  # arm_fault: the armed replica dies at the seam firing
                 node = rng.choice([i for i in ids if i not in down])
                 down.add(node)
@@ -488,7 +544,8 @@ class ChaosSchedule:
         return cls(seed=seed, n=n, durability_window=durability_window,
                    actions=tuple(actions), wan=wan,
                    device_faults=device_faults,
-                   storage_faults=storage_faults)
+                   storage_faults=storage_faults,
+                   adversarial_net=adversarial_net)
 
 
 @dataclasses.dataclass
@@ -887,7 +944,63 @@ class ChaosEngine:
                 **{k: v for k, v in args.items() if k in ("budget", "count")},
             )
             return True
+        if kind == "net_abuse":
+            node = nodes.get(args["node"])
+            if node is None or args["node"] not in members or not node.running:
+                return False
+            self._run_net_abuse(args["node"], node,
+                                args["battery"], args["events"])
+            return True
         raise ValueError(f"unknown chaos action kind {kind!r}")
+
+    def _run_net_abuse(self, nid, node, battery: str, events: int) -> None:
+        """Scripted abuse against ``nid``'s listener guard, on the SIM
+        clock (zero sockets, zero RNG, byte-deterministic).  The guard is
+        attached lazily as ``node.wire_guard`` so the obs sampler exports
+        its counters and the ``wire_abuse`` detector fires; a ban trips
+        the flight recorder and lands in the event log."""
+        from consensus_tpu.net.framing import ListenerGuard
+
+        guard = getattr(node, "wire_guard", None)
+        if guard is None:
+            guard = ListenerGuard(
+                name=f"sim-{nid}",
+                max_conns_per_peer=4,
+                clock=self.cluster.scheduler.now,
+                on_ban=lambda addr, kind, _nid=nid: self._on_wire_ban(
+                    _nid, addr, kind
+                ),
+            )
+            node.wire_guard = guard
+        addr = f"10.66.0.{nid}"  # the (simulated) byzantine peer's address
+        if battery == "stall_flood":
+            for _ in range(events):
+                guard.strike(addr, "stall")
+        elif battery == "garbage_flood":
+            for _ in range(events):
+                guard.strike(addr, "garbage")
+        elif battery == "connect_flood":
+            held = 0
+            for _ in range(guard.max_conns_per_peer):
+                if guard.admit(addr):
+                    held += 1
+            for _ in range(events):
+                guard.admit(addr)  # over quota (or banned): rejected
+            for _ in range(held):
+                guard.release(addr)
+        else:
+            raise ValueError(f"unknown net_abuse battery {battery!r}")
+
+    def _on_wire_ban(self, nid, addr: str, kind: str) -> None:
+        self._emit(
+            f"{self._now():10.4f} wire-ban node={nid} peer={addr} "
+            f"kind={kind}"
+        )
+        if self.recorder is not None:
+            self.recorder.trigger(
+                "wire-abuse-ban", node=nid,
+                detail=f"peer {addr} banned after {kind}",
+            )
 
     def _order_reconfig(self, target_nodes) -> bool:
         """Submit a membership-change request and run until SOME replica
@@ -1382,6 +1495,7 @@ def format_repro(result: ChaosResult) -> str:
         f"    wan={s.wan!r},",
         f"    device_faults={s.device_faults!r},",
         f"    storage_faults={s.storage_faults!r},",
+        f"    adversarial_net={s.adversarial_net!r},",
         "    actions=(",
     ]
     for a in s.actions:
@@ -1396,6 +1510,7 @@ def format_repro(result: ChaosResult) -> str:
 
 
 __all__ = [
+    "ADVERSARIAL_NET_KINDS",
     "ARMABLE_POINTS",
     "CHURN_KINDS",
     "ChaosAction",
@@ -1406,6 +1521,7 @@ __all__ = [
     "DEVICE_FAULT_CLASSES",
     "DEVICE_FAULT_KINDS",
     "FaultInjectingEngine",
+    "NET_ABUSE_BATTERIES",
     "STORAGE_FAULT_CLASSES",
     "STORAGE_FAULT_KINDS",
     "WAN_KINDS",

@@ -52,6 +52,18 @@ Without it, schedules are byte-identical to pre-storage-fault sweeps.
 
     python scripts/chaos_sweep.py --start 0 --count 50 --storage-faults
 
+``--adversarial-net`` adds the byzantine-wire vocabulary to every
+schedule: ``net_abuse`` actions drive scripted listener-guard batteries
+(stall floods, garbage floods, connect floods) against one node's
+hardened wire guard on the sim clock; the guard must shed them (strikes,
+quota rejections, temporary bans — each surfacing through the
+``wire_abuse`` detector and a ``wire-ban`` event-log line) while the
+seed's invariants keep holding.  Per-seed JSON lines gain the booked
+guard totals.  Without it, schedules are byte-identical to
+pre-hardening sweeps.
+
+    python scripts/chaos_sweep.py --start 0 --count 50 --adversarial-net
+
 ``--groups N`` switches the sweep to the CROSS-GROUP vocabulary
 (consensus_tpu/groups/chaos.py): every seed runs N consensus groups over
 one shared scheduler with a cross-group 2PC in flight while the
@@ -216,6 +228,7 @@ def run_sweep(args) -> int:
             durability_window=args.window, churn=args.churn,
             wan=args.wan, device_faults=args.device_faults,
             storage_faults=args.storage_faults,
+            adversarial_net=args.adversarial_net,
         )
         # cert_mode="half-agg" needs an aggregation-capable verifier, so it
         # implies the real-crypto harness; "full" keeps the seed-identical
@@ -254,6 +267,19 @@ def run_sweep(args) -> int:
                                   "detail": detail})
             record["storage_faults_fired"] = fired
             record["quarantines"] = result.event_log.count(b"QUARANTINE")
+        if args.adversarial_net:
+            abuse = {}
+            nodes = engine.cluster.nodes if engine.cluster is not None else {}
+            for nid, node in sorted(nodes.items()):
+                guard = getattr(node, "wire_guard", None)
+                if guard is not None:
+                    abuse[str(nid)] = {
+                        "malformed": guard.stats.malformed,
+                        "bans": guard.stats.bans,
+                        "rejected": guard.stats.rejected,
+                    }
+            record["wire_abuse"] = abuse
+            record["wire_bans"] = result.event_log.count(b"wire-ban")
         print(json.dumps(record, sort_keys=True))
         if result.ok:
             if args.verbose:
@@ -290,6 +316,7 @@ def run_sweep(args) -> int:
             "wan": args.wan,
             "device_faults": args.device_faults,
             "storage_faults": args.storage_faults,
+            "adversarial_net": args.adversarial_net,
             "cert_mode": args.cert_mode,
             "mesh_shards": args.mesh_shards,
             "topology": mesh_label,
@@ -334,6 +361,12 @@ def main() -> int:
                          "schedule's vocabulary; runs on a real "
                          "file-backed WAL with the scrubber, quarantine, "
                          "and learner-fence invariant armed")
+    ap.add_argument("--adversarial-net", action="store_true",
+                    help="add net_abuse actions (scripted byzantine-wire "
+                         "batteries — stall / garbage / connect floods "
+                         "against a node's hardened listener guard) to "
+                         "each schedule's vocabulary; per-seed lines gain "
+                         "the guard's booked totals and wire-ban count")
     ap.add_argument("--groups", type=int, default=0,
                     help="sweep the CROSS-GROUP vocabulary instead: N "
                          "consensus groups over one scheduler, a 2PC in "
@@ -370,7 +403,8 @@ def main() -> int:
                  "--cert-mode half-agg")
     if args.groups:
         if (args.churn or args.wan or args.device_faults
-                or args.storage_faults or args.mesh_shards or args.topology
+                or args.storage_faults or args.adversarial_net
+                or args.mesh_shards or args.topology
                 or args.cert_mode != "full"):
             ap.error("--groups sweeps the cross-group vocabulary and "
                      "cannot be combined with the single-cluster fault "

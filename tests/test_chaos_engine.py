@@ -240,6 +240,36 @@ def test_chaos_sweep_script_storage_faults():
     assert any(r["quarantines"] >= 1 for r in records), records
 
 
+def test_chaos_sweep_script_adversarial_net():
+    """--adversarial-net sweeps drive scripted byzantine-wire batteries
+    against one node's hardened listener guard.  Seed 6 at steps=20 draws
+    a garbage_flood of 3 events — enough strikes to cross the default
+    limit, so its record must carry the guard's booked totals and a
+    wire-ban; the summary params pin the flag for replayability."""
+    import json
+
+    proc, summary = _run_sweep_script("--start", "5", "--count", "2",
+                                      "--steps", "20", "--adversarial-net")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert summary["failed"] == 0
+    assert summary["params"]["adversarial_net"] is True
+    assert summary["anomalies"].get("wire_abuse", 0) >= 1
+    records = []
+    for line in proc.stdout.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "seed" in obj:
+            records.append(obj)
+    assert [r["seed"] for r in records] == [5, 6]
+    for r in records:
+        assert "wire_abuse" in r and "wire_bans" in r
+    booked = [g for r in records for g in r["wire_abuse"].values()]
+    assert any(g["malformed"] >= 1 for g in booked), records
+    assert any(r["wire_bans"] >= 1 for r in records), records
+
+
 @pytest.mark.slow
 def test_chaos_sweep_script_wide():
     proc, summary = _run_sweep_script("--start", "1000", "--count", "60")

@@ -230,3 +230,35 @@ def test_lint_covers_groups_plane():
     assert proc.returncode == 0, (
         "groups plane has wall-clock reads:\n" + proc.stdout + proc.stderr
     )
+
+
+def test_lint_covers_adversarial_net_edge():
+    """The adversarial network edge (ISSUE 20): the wire fuzzer promises
+    byte-identical mutation streams per seed (no clock in the loop at
+    all), the AdversarialPeer batteries deliberately block only on socket
+    timeouts (zero wallclock escapes, so a deadline can never desync a
+    battery from the defense it provokes), and the shared framing guard's
+    real-time reads (ban expiry, deadlines) must each be an audited
+    ``# wallclock-ok`` escape.  Pin presence, then walk each file."""
+    testing_dir = os.path.join(_REPO, "consensus_tpu", "testing")
+    net_dir = os.path.join(_REPO, "consensus_tpu", "net")
+    assert {"fuzz.py", "adversary.py"} <= {
+        f for f in os.listdir(testing_dir) if f.endswith(".py")
+    }
+    assert "framing.py" in {
+        f for f in os.listdir(net_dir) if f.endswith(".py")
+    }
+    for target in (
+        os.path.join(testing_dir, "fuzz.py"),
+        os.path.join(testing_dir, "adversary.py"),
+        os.path.join(net_dir, "framing.py"),
+    ):
+        proc = subprocess.run(
+            [sys.executable, _SCRIPT, target],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, (
+            f"adversarial net edge {target} has unaudited wall-clock "
+            "reads:\n" + proc.stdout + proc.stderr
+        )

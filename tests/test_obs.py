@@ -40,7 +40,11 @@ from consensus_tpu.obs import (
     sparkline,
 )
 from consensus_tpu.obs.detectors import ANOMALY_KINDS
-from consensus_tpu.obs.export import HEALTH_FIELDS, render_watch
+from consensus_tpu.obs.export import (
+    HEALTH_FIELDS,
+    OPTIONAL_HEALTH_FIELDS,
+    render_watch,
+)
 from consensus_tpu.obs.flightrec import FlightRecorder
 from consensus_tpu.runtime.scheduler import SimScheduler
 from consensus_tpu.testing.app import Cluster, make_request
@@ -148,7 +152,10 @@ def test_sampling_is_observationally_transparent():
     # The closing sample backs ChaosResult.final_health for every node.
     assert set(observed.final_health) == {"1", "2", "3", "4"}
     for health in observed.final_health.values():
-        assert set(HEALTH_FIELDS) <= set(health)
+        # Required fields always; the optional guard surface only appears
+        # on nodes carrying a wire_guard, which this clean run has none of.
+        assert set(HEALTH_FIELDS) - set(OPTIONAL_HEALTH_FIELDS) <= set(health)
+        assert not set(OPTIONAL_HEALTH_FIELDS) & set(health)
     # Per-node sample counters (pinned key) agree with the ring count.
     for node in engine.cluster.nodes.values():
         dump = node.metrics.provider.dump()
@@ -207,8 +214,11 @@ def test_leader_churn_schedule_fires_storm_and_flap_detectors():
     storage_kinds = {"wal_corruption", "wal_stall"}
     # tests/test_groups_2pc.py fires cross_group_stall end-to-end.
     groups_kinds = {"cross_group_stall"}
+    # tests/test_net_hardening.py fires wire_abuse end-to-end (sim chaos
+    # net_abuse arm + detector unit).
+    wire_kinds = {"wire_abuse"}
     assert (partition_kinds | churn_kinds | ingress_kinds | engine_kinds
-            | storage_kinds | groups_kinds
+            | storage_kinds | groups_kinds | wire_kinds
             | set(counts) >= set(ANOMALY_KINDS))
 
 
@@ -374,6 +384,8 @@ def test_prometheus_export_is_well_formed_and_sorted():
     assert families == sorted(families)
     assert "obs_sample_time" in families
     for field in HEALTH_FIELDS:
+        if field in OPTIONAL_HEALTH_FIELDS:
+            continue  # emitted only when a wire_guard is attached
         assert f"obs_health_{field}" in families
     # Every node labeled on every health family.
     assert 'obs_health_ledger{node="1"} 5' in lines

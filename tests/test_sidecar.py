@@ -908,3 +908,59 @@ def test_tenant_isolation_under_chaos_flood():
     assert rejects[0] > 0, "the flooding tenant was never admission-rejected"
     collapse = [a for a in result.anomalies if a.kind == "verify_collapse"]
     assert not collapse, f"flood starved honest verify launches: {collapse}"
+
+
+def test_wrong_secret_handshake_flood_never_starves_honest_tenants():
+    """ISSUE 20 companion to the admission flood above: this flood never
+    AUTHENTICATES — every connection fails the handshake proof outright
+    (an outsider guessing secrets, not a tenant over quota).  The hardened
+    listener guard strikes each failure as ``bad_hello`` and the honest
+    tenant's verifies keep succeeding throughout."""
+    from consensus_tpu.net.framing import ListenerGuard
+    from consensus_tpu.testing.adversary import AdversarialPeer
+
+    # Honest clients share 127.0.0.1 with the flood, so keep the strike
+    # limit above the flood volume: the defense under test here is the
+    # strike accounting + per-connection shedding, not the ban.
+    guard = ListenerGuard(
+        name="sidecar", handshake_timeout=0.5, strike_limit=10_000
+    )
+    server = VerifySidecarServer(
+        ("127.0.0.1", 0), FakeEngine(), auth_secret=SECRET, tenants=TENANTS,
+        wave_window=0.002, guard=guard,
+    )
+    server.start()
+    stop = threading.Event()
+    flood_events = [0]
+
+    def flood():
+        adv = AdversarialPeer(server.address, "sidecar", close_wait=5.0)
+        while not stop.is_set():
+            try:
+                adv.wrong_hmac_flood(1)
+                flood_events[0] += 1
+            except OSError:
+                pass
+
+    flooder = threading.Thread(target=flood, daemon=True)
+    flooder.start()
+    try:
+        client = _tenant_client(server.address, "alpha")
+        try:
+            for i in range(25):
+                pattern = [b"good" if j % 2 else b"bad" for j in range(8)]
+                out = client.verify_batch([b"m"] * 8, pattern, [b"k"] * 8)
+                assert list(out) == [s == b"good" for s in pattern], (
+                    f"honest verify {i} corrupted under handshake flood"
+                )
+        finally:
+            client.close()
+    finally:
+        stop.set()
+        flooder.join(timeout=10.0)
+        server.stop()
+
+    assert flood_events[0] > 0, "the flood never ran"
+    # Every failed proof was booked as a bad_hello strike, exactly once.
+    assert guard.stats.malformed >= flood_events[0]
+    assert guard.stats.bans == 0  # under the limit by construction
